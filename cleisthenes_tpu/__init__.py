@@ -1,0 +1,52 @@
+"""cleisthenes-tpu: a TPU-native HoneyBadgerBFT consensus framework.
+
+A from-scratch, complete implementation of asynchronous Byzantine
+fault-tolerant consensus (HoneyBadgerBFT: ACS = N x RBC + N x BBA, with
+threshold encryption for censorship resistance), with the same
+capabilities and API shape as the Go reference library ``cleisthenes``
+(see /root/reference, surveyed in SURVEY.md) — but architected for TPU:
+
+- The asynchronous *protocol plane* (connections, epochs, RBC/BBA state
+  machines, quorum counting) runs host-side on asyncio, mirroring the
+  reference's goroutine-actor design (reference conn.go:104-128,
+  bba/bba.go:113-123).
+- The *crypto plane* — GF(2^8) Reed-Solomon erasure coding, SHA-256
+  Merkle forests, threshold-encryption share operations and the
+  threshold common coin — is batched, fixed-shape JAX/XLA vmapped across
+  the validator axis, behind a ``BatchCrypto``/``ErasureCoder`` seam
+  with a CPU reference backend (numpy + native C++), selected by config.
+
+Public API parity map (reference file:line -> here):
+  NewHoneyBadger(batchSize, nodes)   honeybadger.go:36  -> HoneyBadger
+  HoneyBadger.AddTransaction(tx)     honeybadger.go:52  -> HoneyBadger.add_transaction
+  Transaction interface{}            honeybadger.go:115 -> Transaction (opaque bytes/any)
+  Batch.TxList()                     honeybadger.go:14  -> Batch.tx_list
+  Config                             cleisthenes.go:3   -> Config
+  Member/MemberMap                   member_map.go      -> core.member
+  Connection/Broadcaster/Handler     conn.go:27-38,182  -> transport.base
+"""
+
+from cleisthenes_tpu.config import Config
+from cleisthenes_tpu.core.batch import Batch
+from cleisthenes_tpu.core.member import Address, Member, MemberMap
+from cleisthenes_tpu.core.queue import (
+    EmptyQueueError,
+    IndexBoundaryError,
+    Transaction,
+    TxQueue,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Config",
+    "Batch",
+    "Address",
+    "Member",
+    "MemberMap",
+    "TxQueue",
+    "Transaction",
+    "EmptyQueueError",
+    "IndexBoundaryError",
+    "__version__",
+]

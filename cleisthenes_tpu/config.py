@@ -1,0 +1,89 @@
+"""Single framework configuration object.
+
+The reference declares an (empty) ``Config`` struct as the intended
+one-stop config (reference cleisthenes.go:3-4, consumed by
+``NewRBC(config cleisthenes.Config)`` at rbc/rbc.go:38); its real knobs
+live in constructor args (``NewHoneyBadger(batchSize, nodes)``,
+honeybadger.go:36) and constants (``DefaultDialTimeout = 3s``,
+comm.go:107-109; channel caps 200, conn.go:60-61).  Here the config is a
+real dataclass carrying every knob, including the TPU-build additions:
+``crypto_backend`` (the ``--crypto=tpu`` flag from BASELINE.json) and
+the device-mesh layout for the batched crypto plane.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+DEFAULT_DIAL_TIMEOUT_S = 3.0  # reference comm.go:107-109
+DEFAULT_CHANNEL_CAPACITY = 200  # reference conn.go:60-61 (out/read chans)
+
+
+@dataclasses.dataclass
+class Config:
+    """Framework-wide configuration.
+
+    Attributes:
+      n: number of validators in the network (N).
+      f: Byzantine fault budget; requires N >= 3f+1
+         (reference docs/BBA-EN.md:26, docs/HONEYBADGER-EN.md:35).
+         Defaults to floor((n-1)/3), the maximum tolerable.
+      batch_size: target committed transactions per epoch (B). The
+        effective per-node proposal is B/N randomly sampled from the
+        head of the queue (reference honeybadger.go:36-49,62-104;
+        docs/HONEYBADGER-EN.md:49-56).
+      crypto_backend: 'cpu' (numpy + native C++ reference path) or
+        'tpu' (batched JAX/XLA kernels) — the BatchCrypto/ErasureCoder
+        seam from BASELINE.json.
+      dial_timeout_s: client dial timeout (reference comm.go:107-109).
+      channel_capacity: per-connection mailbox depth (conn.go:60-61).
+      seed: deterministic seed for batch sampling / test schedulers.
+      coin_seed: shared setup seed for the threshold common-coin and
+        TPKE key generation in trusted-dealer mode.
+      mesh_shape: optional device-mesh layout (validators, shardlen)
+        for sharding the crypto plane across TPU devices; None means
+        single-device.
+    """
+
+    n: int = 4
+    f: Optional[int] = None
+    batch_size: int = 256
+    crypto_backend: str = "cpu"
+    dial_timeout_s: float = DEFAULT_DIAL_TIMEOUT_S
+    channel_capacity: int = DEFAULT_CHANNEL_CAPACITY
+    seed: int = 0
+    coin_seed: int = 1
+    mesh_shape: Optional[tuple] = None
+
+    def __post_init__(self) -> None:
+        if self.n < 1:
+            raise ValueError(f"n={self.n} must be >= 1")
+        if self.f is None:
+            self.f = (self.n - 1) // 3
+        if self.f < 0:
+            raise ValueError(f"f={self.f} must be >= 0")
+        if self.n < 3 * self.f + 1:
+            raise ValueError(
+                f"n={self.n} must be >= 3f+1={3 * self.f + 1} "
+                "(docs/BBA-EN.md:26: t < n/3)"
+            )
+        if self.crypto_backend not in ("cpu", "tpu"):
+            raise ValueError(f"unknown crypto_backend {self.crypto_backend!r}")
+
+    @property
+    def data_shards(self) -> int:
+        """K = N - 2f data shards for RS coding (docs/RBC-EN.md:30)."""
+        return self.n - 2 * self.f
+
+    @property
+    def parity_shards(self) -> int:
+        """2f parity shards so any N-2f of N shards reconstruct."""
+        return 2 * self.f
+
+    @property
+    def decryption_threshold(self) -> int:
+        """f+1 decryption shares recover a TPKE plaintext
+        (docs/HONEYBADGER-EN.md:40-42, docs/THRESHOLD_ENCRYPTION-EN.md:33-36)."""
+        return self.f + 1

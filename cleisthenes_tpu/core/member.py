@@ -1,0 +1,77 @@
+"""Network roster: Address, Member, MemberMap.
+
+Mirrors reference member_map.go: ``Address{Ip, Port}``
+(member_map.go:12-19), ``Member{Id, Addr}`` (member_map.go:22-25), and
+the RWMutex-guarded id->member ``MemberMap`` with Members/Member/Add/Del
+(member_map.go:43-87).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, List, Optional
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class Address:
+    """Peer network address (reference member_map.go:12-19)."""
+
+    ip: str
+    port: int
+
+    def __str__(self) -> str:
+        return f"{self.ip}:{self.port}"
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class Member:
+    """A validator identity: id + address (reference member_map.go:22-25).
+
+    ``id`` is an opaque string (the reference uses uuid strings for
+    connection ids, comm.go:46); for consensus we conventionally use
+    stable validator names so Shamir share indices can be derived from
+    roster order.
+    """
+
+    id: str
+    addr: Address = Address("", 0)
+
+    def address(self) -> Address:
+        """Reference member_map.go:38."""
+        return self.addr
+
+
+class MemberMap:
+    """Lock-guarded id -> Member map (reference member_map.go:43-87)."""
+
+    def __init__(self) -> None:
+        self._members: Dict[str, Member] = {}
+        self._lock = threading.RLock()
+
+    def add(self, member: Member) -> None:
+        with self._lock:
+            self._members[member.id] = member
+
+    def delete(self, member_id: str) -> None:
+        """Reference member_map.go:82-87 (Del)."""
+        with self._lock:
+            self._members.pop(member_id, None)
+
+    def member(self, member_id: str) -> Optional[Member]:
+        with self._lock:
+            return self._members.get(member_id)
+
+    def members(self) -> List[Member]:
+        """Snapshot of all members, sorted by id for deterministic
+        roster order (share indices, proposer ordering)."""
+        with self._lock:
+            return sorted(self._members.values(), key=lambda m: m.id)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._members)
+
+    def __contains__(self, member_id: str) -> bool:
+        with self._lock:
+            return member_id in self._members

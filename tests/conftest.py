@@ -1,0 +1,27 @@
+"""Test harness configuration.
+
+Tests never require real TPU hardware: JAX is pinned to the CPU
+platform with 8 virtual devices so multi-device sharding (shard_map
+over a Mesh) is exercised exactly as it would be on a v5e slice.  This
+must run before the first ``import jax`` anywhere in the test session.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def jax_cpu_devices():
+    import jax
+
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual CPU devices, got {len(devs)}"
+    return devs

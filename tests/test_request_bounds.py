@@ -1,0 +1,28 @@
+"""DoS bounds on the epoch catch-up buffer and config validation."""
+
+import pytest
+
+from cleisthenes_tpu import Config
+from cleisthenes_tpu.core.request import IncomingRequestRepository
+
+
+def test_far_future_epoch_dropped():
+    r = IncomingRequestRepository(max_epoch_horizon=4)
+    assert r.save(epoch=100, conn_id="byz", req="x", current_epoch=1) is False
+    assert r.save(epoch=5, conn_id="byz", req="x", current_epoch=1) is True
+    assert r.dropped == 1
+
+
+def test_per_sender_cap():
+    r = IncomingRequestRepository(max_per_sender=3)
+    for i in range(5):
+        r.save(epoch=2, conn_id="byz", req=i, current_epoch=1)
+    assert len(r.find_all(2)) == 3
+    assert r.dropped == 2
+
+
+def test_config_rejects_nonpositive_n_and_negative_f():
+    with pytest.raises(ValueError):
+        Config(n=0)
+    with pytest.raises(ValueError):
+        Config(n=4, f=-1)
