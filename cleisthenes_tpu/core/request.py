@@ -79,18 +79,19 @@ class IncomingRequestRepository:
         self.dropped = 0
 
     def save(
-        self, epoch: int, conn_id: str, req: Request, current_epoch: int = None
+        self, epoch: int, conn_id: str, req: Request, current_epoch: int
     ) -> bool:
-        """Buffer ``req`` for ``epoch``; returns False if dropped.
+        """Buffer ``req`` for a future ``epoch``; returns False if dropped.
 
-        Messages beyond ``current_epoch + max_epoch_horizon`` or in
-        excess of ``max_per_sender`` per (epoch, sender) are dropped —
-        a correct peer never needs either.
+        Only strictly-future epochs within ``max_epoch_horizon`` are
+        buffered (current-epoch messages are handled directly and
+        past-epoch messages are useless), and at most
+        ``max_per_sender`` per (epoch, sender) — a correct peer never
+        needs more.
         """
         with self._lock:
-            if (
-                current_epoch is not None
-                and epoch > current_epoch + self._max_epoch_horizon
+            if not (
+                current_epoch < epoch <= current_epoch + self._max_epoch_horizon
             ):
                 self.dropped += 1
                 return False
@@ -110,9 +111,15 @@ class IncomingRequestRepository:
             return out
 
     def pop_epoch(self, epoch: int) -> List[Tuple[str, Request]]:
-        """Drain and return everything buffered for ``epoch``."""
+        """Drain and return everything buffered for ``epoch``.
+
+        Also garbage-collects anything parked for earlier epochs — a
+        node draining epoch e will never revisit e' < e.
+        """
         with self._lock:
             buf = self._reqs.pop(epoch, {})
+            for stale in [e for e in self._reqs if e < epoch]:
+                del self._reqs[stale]
         out: List[Tuple[str, Request]] = []
         for conn_id, reqs in buf.items():
             out.extend((conn_id, r) for r in reqs)

@@ -1,0 +1,18 @@
+"""The crypto plane: batched, fixed-shape TPU kernels + CPU references.
+
+This package is the TPU-native replacement for the reference's native
+hot loops (SURVEY.md §2.3): the GF(2^8) Reed-Solomon codec that the
+reference takes from klauspost/reedsolomon's SIMD assembly
+(reference go.mod:10, rbc/rbc.go:7,21,98), the SHA-256 Merkle forest
+(reference docs/RBC-EN.md:31-45), and the modular-arithmetic engine
+behind threshold encryption and the common coin
+(reference docs/THRESHOLD_ENCRYPTION-EN.md:33-36, docs/BBA-EN.md:163-181).
+"""
+
+from cleisthenes_tpu.ops.backend import (
+    BatchCrypto,
+    ErasureCoder,
+    get_backend,
+)
+
+__all__ = ["BatchCrypto", "ErasureCoder", "get_backend"]

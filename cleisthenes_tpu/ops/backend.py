@@ -1,0 +1,87 @@
+"""The BatchCrypto / ErasureCoder seam.
+
+BASELINE.json's north star names this interface: the per-epoch crypto
+(RS encode/decode, Merkle proofs, TPKE share ops, coin combine) sits
+behind ``BatchCrypto``/``ErasureCoder`` with ``cpu`` and ``tpu``
+backends selected by config — the seam that keeps every protocol test
+runnable without a TPU.  It mirrors the reference's only pluggable hot
+path, the ``reedsolomon.Encoder`` held by RBC (reference rbc/rbc.go:21).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Sequence
+
+import numpy as np
+
+
+class ErasureCoder(abc.ABC):
+    """Systematic (n, k) Reed-Solomon codec over GF(2^8).
+
+    Shards are byte matrices: ``data`` is (k, L), full shard sets are
+    (n, L) with rows 0..k-1 the data shards and rows k..n-1 parity
+    (reference rbc/rbc.go:98-100 `shard`, :88-90 `interpolate`).
+    """
+
+    def __init__(self, n: int, k: int):
+        if not (1 <= k <= n <= 256):
+            raise ValueError(f"need 1 <= k <= n <= 256, got n={n} k={k}")
+        self.n = n
+        self.k = k
+
+    @abc.abstractmethod
+    def encode(self, data: np.ndarray) -> np.ndarray:
+        """(k, L) data shards -> (n, L) data+parity shards."""
+
+    @abc.abstractmethod
+    def decode(self, indices: Sequence[int], shards: np.ndarray) -> np.ndarray:
+        """Reconstruct the (k, L) data shards from any k survivors.
+
+        ``indices``: which of the n shard rows the k given shards are
+        (distinct, ascending not required).  ``shards``: (k, L).
+        """
+
+    def encode_batch(self, data: np.ndarray) -> np.ndarray:
+        """(B, k, L) -> (B, n, L); default loops, backends override."""
+        return np.stack([self.encode(d) for d in data])
+
+    def decode_batch(
+        self, indices: np.ndarray, shards: np.ndarray
+    ) -> np.ndarray:
+        """(B, k) indices + (B, k, L) shards -> (B, k, L) data."""
+        return np.stack(
+            [self.decode(list(ix), sh) for ix, sh in zip(indices, shards)]
+        )
+
+
+def make_erasure_coder(backend: str, n: int, k: int) -> ErasureCoder:
+    if backend == "cpu":
+        from cleisthenes_tpu.ops.rs_cpu import CpuErasureCoder
+
+        return CpuErasureCoder(n, k)
+    if backend == "tpu":
+        from cleisthenes_tpu.ops.rs_xla import XlaErasureCoder
+
+        return XlaErasureCoder(n, k)
+    raise ValueError(f"unknown erasure backend {backend!r}")
+
+
+class BatchCrypto:
+    """Bundle of crypto-plane backends for one (n, f) configuration.
+
+    Grows as subsystems land: erasure coding, Merkle forest, TPKE,
+    common coin.  ``get_backend(config)`` is the single construction
+    point used by the protocol layer.
+    """
+
+    def __init__(self, backend: str, n: int, f: int):
+        self.backend = backend
+        self.n = n
+        self.f = f
+        self.k = n - 2 * f if n > 1 else 1
+        self.erasure = make_erasure_coder(backend, n, self.k)
+
+
+def get_backend(config) -> BatchCrypto:
+    return BatchCrypto(config.crypto_backend, config.n, config.f)

@@ -1,0 +1,46 @@
+"""CPU reference Reed-Solomon codec (numpy table lookups).
+
+The correctness anchor for the TPU codec, standing in for the
+reference's klauspost/reedsolomon SIMD dependency (reference go.mod:10)
+until the native C++ backend supersedes it for speed.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Sequence
+
+import numpy as np
+
+from cleisthenes_tpu.ops import gf256
+from cleisthenes_tpu.ops.backend import ErasureCoder
+
+
+class CpuErasureCoder(ErasureCoder):
+    def __init__(self, n: int, k: int):
+        super().__init__(n, k)
+        self.matrix = gf256.systematic_rs_matrix(n, k)
+
+    def encode(self, data: np.ndarray) -> np.ndarray:
+        data = np.ascontiguousarray(data, dtype=np.uint8)
+        assert data.ndim == 2 and data.shape[0] == self.k, data.shape
+        if self.n == self.k:
+            return data.copy()
+        parity = gf256.gf_matmul(self.matrix[self.k :], data)
+        return np.concatenate([data, parity], axis=0)
+
+    @functools.lru_cache(maxsize=512)
+    def _decode_matrix(self, indices: tuple) -> np.ndarray:
+        return gf256.gf_mat_inv(self.matrix[list(indices)])
+
+    def decode(self, indices: Sequence[int], shards: np.ndarray) -> np.ndarray:
+        indices = tuple(int(i) for i in indices)
+        if len(indices) != self.k or len(set(indices)) != self.k:
+            raise ValueError(
+                f"need exactly k={self.k} distinct shard indices, got {indices}"
+            )
+        shards = np.ascontiguousarray(shards, dtype=np.uint8)
+        assert shards.shape[0] == self.k, shards.shape
+        if indices == tuple(range(self.k)):
+            return shards.copy()
+        return gf256.gf_matmul(self._decode_matrix(indices), shards)

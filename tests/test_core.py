@@ -142,9 +142,9 @@ class TestIncomingRequestRepository:
         """Future-epoch messages are parked and replayed
         (reference bba/request.go:28-32)."""
         r = IncomingRequestRepository()
-        r.save(epoch=2, conn_id="c1", req="late1")
-        r.save(epoch=2, conn_id="c1", req="late2")
-        r.save(epoch=3, conn_id="c2", req="later")
+        r.save(epoch=2, conn_id="c1", req="late1", current_epoch=1)
+        r.save(epoch=2, conn_id="c1", req="late2", current_epoch=1)
+        r.save(epoch=3, conn_id="c2", req="later", current_epoch=1)
         assert r.find_all(2) == [("c1", "late1"), ("c1", "late2")]
         drained = r.pop_epoch(2)
         assert drained == [("c1", "late1"), ("c1", "late2")]

@@ -1,0 +1,167 @@
+"""Property tests for GF(2^8) math and both Reed-Solomon backends.
+
+Mirrors the reference's TDD matrix for RBC internals
+(rbc/rbc_internal_test.go:5-31: shard, interpolate, validateMessage)
+plus field-axiom checks, at N sizes up to the BASELINE north-star
+(N=128, f=42).
+"""
+
+import numpy as np
+import pytest
+
+from cleisthenes_tpu.ops import gf256
+from cleisthenes_tpu.ops.backend import make_erasure_coder
+from cleisthenes_tpu.ops.payload import join_payload, split_payload
+
+rng = np.random.default_rng(42)
+
+
+class TestGF256:
+    def test_field_axioms_sampled(self):
+        for _ in range(200):
+            a, b, c = (int(x) for x in rng.integers(0, 256, 3))
+            assert gf256.gf_mul(a, b) == gf256.gf_mul(b, a)
+            assert gf256.gf_mul(a, gf256.gf_mul(b, c)) == gf256.gf_mul(
+                gf256.gf_mul(a, b), c
+            )
+            # distributivity over XOR (field addition)
+            assert gf256.gf_mul(a, b ^ c) == gf256.gf_mul(a, b) ^ gf256.gf_mul(a, c)
+
+    def test_inverse(self):
+        for a in range(1, 256):
+            assert gf256.gf_mul(a, gf256.gf_inv(a)) == 1
+        with pytest.raises(ZeroDivisionError):
+            gf256.gf_inv(0)
+
+    def test_mul_table_matches_scalar(self):
+        a = rng.integers(0, 256, 64)
+        b = rng.integers(0, 256, 64)
+        for x, y in zip(a, b):
+            assert gf256.GF_MUL_TABLE[x, y] == gf256.gf_mul(int(x), int(y))
+
+    def test_mat_inv_roundtrip(self):
+        for k in (1, 2, 5, 16):
+            m = gf256.systematic_rs_matrix(min(256, 3 * k), k)[k : 2 * k]
+            # rows k..2k-1 of a systematic RS matrix are invertible
+            inv = gf256.gf_mat_inv(m)
+            assert np.array_equal(
+                gf256.gf_matmul(m, inv), np.eye(k, dtype=np.uint8)
+            )
+
+    def test_mat_inv_singular(self):
+        m = np.zeros((3, 3), dtype=np.uint8)
+        with pytest.raises(np.linalg.LinAlgError):
+            gf256.gf_mat_inv(m)
+
+    def test_bit_lifting_equals_gf_matmul(self):
+        a = rng.integers(0, 256, (6, 4)).astype(np.uint8)
+        x = rng.integers(0, 256, (4, 33)).astype(np.uint8)
+        want = gf256.gf_matmul(a, x)
+        g = gf256.lift_to_bits(a)
+        got_bits = (g.astype(np.int64) @ gf256.bytes_to_bits(x).astype(np.int64)) & 1
+        assert np.array_equal(gf256.bits_to_bytes(got_bits.astype(np.uint8)), want)
+
+    def test_bytes_bits_roundtrip(self):
+        x = rng.integers(0, 256, (7, 19)).astype(np.uint8)
+        assert np.array_equal(gf256.bits_to_bytes(gf256.bytes_to_bits(x)), x)
+
+
+@pytest.mark.parametrize("backend", ["cpu", "tpu"])
+@pytest.mark.parametrize(
+    "n,f",
+    [(4, 1), (7, 2), (16, 5), (128, 42)],
+)
+class TestErasureCoder:
+    def test_roundtrip_random_erasures(self, backend, n, f):
+        k = n - 2 * f
+        coder = make_erasure_coder(backend, n, k)
+        data = rng.integers(0, 256, (k, 128)).astype(np.uint8)
+        shards = coder.encode(data)
+        assert shards.shape == (n, 128)
+        assert np.array_equal(shards[:k], data)  # systematic
+        for _ in range(3):
+            survivors = np.sort(rng.choice(n, size=k, replace=False))
+            rec = coder.decode([int(i) for i in survivors], shards[survivors])
+            assert np.array_equal(rec, data)
+
+    def test_worst_case_erasure(self, backend, n, f):
+        """Lose ALL data shards; reconstruct from parity alone where
+        possible (2f parity rows can replace up to 2f data rows)."""
+        k = n - 2 * f
+        coder = make_erasure_coder(backend, n, k)
+        data = rng.integers(0, 256, (k, 64)).astype(np.uint8)
+        shards = coder.encode(data)
+        lost = min(2 * f, k)
+        survivors = list(range(lost, k)) + list(range(k, k + lost))
+        rec = coder.decode(survivors, shards[survivors])
+        assert np.array_equal(rec, data)
+
+
+@pytest.mark.parametrize("n,f", [(4, 1), (16, 5)])
+def test_backends_agree(n, f):
+    k = n - 2 * f
+    cpu = make_erasure_coder("cpu", n, k)
+    tpu = make_erasure_coder("tpu", n, k)
+    data = rng.integers(0, 256, (k, 256)).astype(np.uint8)
+    assert np.array_equal(cpu.encode(data), tpu.encode(data))
+    shards = cpu.encode(data)
+    survivors = list(range(n - k, n))
+    assert np.array_equal(
+        cpu.decode(survivors, shards[survivors]),
+        tpu.decode(survivors, shards[survivors]),
+    )
+
+
+@pytest.mark.parametrize("backend", ["cpu", "tpu"])
+def test_batched_matches_single(backend):
+    n, f = 7, 2
+    k = n - 2 * f
+    coder = make_erasure_coder(backend, n, k)
+    data = rng.integers(0, 256, (5, k, 128)).astype(np.uint8)
+    enc = coder.encode_batch(data)
+    for b in range(5):
+        assert np.array_equal(enc[b], coder.encode(data[b]))
+    idx = np.stack([np.sort(rng.choice(n, k, replace=False)) for _ in range(5)])
+    shards = np.stack([enc[b][idx[b]] for b in range(5)])
+    dec = coder.decode_batch(idx, shards)
+    for b in range(5):
+        assert np.array_equal(dec[b], data[b])
+
+
+def test_decode_rejects_bad_indices():
+    coder = make_erasure_coder("cpu", 4, 2)
+    with pytest.raises(ValueError):
+        coder.decode([0], np.zeros((1, 8), dtype=np.uint8))
+    with pytest.raises(ValueError):
+        coder.decode([1, 1], np.zeros((2, 8), dtype=np.uint8))
+
+
+class TestPayload:
+    def test_roundtrip(self):
+        payload = bytes(rng.integers(0, 256, 1000, dtype=np.uint8))
+        m = split_payload(payload, k=5)
+        assert m.shape[0] == 5 and m.shape[1] % 128 == 0
+        assert join_payload(m) == payload
+
+    def test_empty_payload(self):
+        m = split_payload(b"", k=3)
+        assert join_payload(m) == b""
+
+    def test_corrupt_length_rejected(self):
+        m = split_payload(b"hello", k=2)
+        m[0, :4] = 255
+        with pytest.raises(ValueError):
+            join_payload(m)
+
+    def test_full_rbc_flow(self):
+        """split -> encode -> erase -> decode -> join, both backends."""
+        n, f = 7, 2
+        k = n - 2 * f
+        payload = bytes(rng.integers(0, 256, 4096, dtype=np.uint8))
+        data = split_payload(payload, k)
+        for backend in ("cpu", "tpu"):
+            coder = make_erasure_coder(backend, n, k)
+            shards = coder.encode(data)
+            survivors = [1, 3, 6]  # any k of n
+            rec = coder.decode(survivors, shards[survivors])
+            assert join_payload(rec) == payload

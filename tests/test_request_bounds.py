@@ -26,3 +26,18 @@ def test_config_rejects_nonpositive_n_and_negative_f():
         Config(n=0)
     with pytest.raises(ValueError):
         Config(n=4, f=-1)
+
+
+def test_past_and_current_epoch_dropped():
+    r = IncomingRequestRepository()
+    assert r.save(epoch=1, conn_id="c", req="x", current_epoch=1) is False
+    assert r.save(epoch=0, conn_id="c", req="x", current_epoch=1) is False
+    assert r.dropped == 2
+
+
+def test_pop_epoch_gcs_stale():
+    r = IncomingRequestRepository()
+    r.save(epoch=2, conn_id="c", req="a", current_epoch=1)
+    r.save(epoch=3, conn_id="c", req="b", current_epoch=1)
+    r.pop_epoch(3)  # skipped past epoch 2
+    assert r.find_all(2) == []
