@@ -34,13 +34,34 @@ class ErasureCoder(abc.ABC):
     def encode(self, data: np.ndarray) -> np.ndarray:
         """(k, L) data shards -> (n, L) data+parity shards."""
 
-    @abc.abstractmethod
+    def _normalize_indices(self, indices: Sequence[int]) -> tuple:
+        out = tuple(int(i) for i in indices)
+        if len(out) != self.k or len(set(out)) != self.k:
+            raise ValueError(
+                f"need exactly k={self.k} distinct shard indices, got {out}"
+            )
+        if not all(0 <= i < self.n for i in out):
+            raise ValueError(f"shard indices out of range [0, {self.n}): {out}")
+        return out
+
     def decode(self, indices: Sequence[int], shards: np.ndarray) -> np.ndarray:
         """Reconstruct the (k, L) data shards from any k survivors.
 
         ``indices``: which of the n shard rows the k given shards are
         (distinct, ascending not required).  ``shards``: (k, L).
         """
+        indices = self._normalize_indices(indices)
+        shards = np.ascontiguousarray(shards, dtype=np.uint8)
+        if shards.ndim != 2 or shards.shape[0] != self.k:
+            raise ValueError(f"expected (k={self.k}, L) shards, got {shards.shape}")
+        if indices == tuple(range(self.k)):
+            return shards.copy()
+        return self._decode_impl(indices, shards)
+
+    @abc.abstractmethod
+    def _decode_impl(self, indices: tuple, shards: np.ndarray) -> np.ndarray:
+        """Backend decode after validation; indices are k distinct ints
+        and not the identity pattern."""
 
     def encode_batch(self, data: np.ndarray) -> np.ndarray:
         """(B, k, L) -> (B, n, L); default loops, backends override."""
@@ -75,13 +96,20 @@ class BatchCrypto:
     point used by the protocol layer.
     """
 
-    def __init__(self, backend: str, n: int, f: int):
+    def __init__(self, backend: str, n: int, f: int, k: int):
+        from cleisthenes_tpu.ops.merkle import make_merkle
+
         self.backend = backend
         self.n = n
         self.f = f
-        self.k = n - 2 * f if n > 1 else 1
-        self.erasure = make_erasure_coder(backend, n, self.k)
+        self.k = k
+        self.erasure = make_erasure_coder(backend, n, k)
+        self.merkle = make_merkle(backend)
 
 
 def get_backend(config) -> BatchCrypto:
-    return BatchCrypto(config.crypto_backend, config.n, config.f)
+    # k comes from Config.data_shards, the single source of the
+    # N - 2f formula (validated there against n >= 3f+1).
+    return BatchCrypto(
+        config.crypto_backend, config.n, config.f, config.data_shards
+    )

@@ -1,0 +1,219 @@
+"""Merkle forest: batched tree build and branch verification.
+
+RBC attaches to every VAL/ECHO a Merkle root h and branch b(j) proving
+shard s(j) (reference rbc/request.go:9-13, docs/RBC-EN.md:31-39); after
+interpolation the root is recomputed to catch corrupt shards
+(docs/RBC-EN.md:37-38).  The network-wide cost is N^2 log N hashes per
+epoch (docs/HONEYBADGER-EN.md:96) — all independent, so both the build
+(one tree per validator's proposal) and the verify (N branches per
+delivered instance) are batched onto the TPU via sha256_xla.
+
+Convention: leaf digest = SHA256(0x00 || shard), interior node =
+SHA256(0x01 || left || right) (domain separation against second-
+preimage splices); leaf sets pad to the next power of two with a fixed
+sentinel digest.
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+import hashlib
+from typing import List, Sequence
+
+import numpy as np
+
+_LEAF_PREFIX = b"\x00"
+_NODE_PREFIX = b"\x01"
+_EMPTY_LEAF_DIGEST = hashlib.sha256(b"cleisthenes-tpu:empty-leaf").digest()
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+@dataclasses.dataclass
+class MerkleTree:
+    """A built tree: levels[0] is the (padded) leaf-digest row, levels[-1]
+    is the single root digest.  All rows are (width, 32) uint8."""
+
+    levels: List[np.ndarray]
+    n_leaves: int
+
+    @property
+    def root(self) -> bytes:
+        return self.levels[-1][0].tobytes()
+
+    def branch(self, index: int) -> List[bytes]:
+        """Sibling path for leaf ``index``, bottom-up
+        (the b(j) of reference rbc/request.go:11)."""
+        if not (0 <= index < self.n_leaves):
+            raise IndexError(index)
+        out = []
+        for level in self.levels[:-1]:
+            out.append(level[index ^ 1].tobytes())
+            index >>= 1
+        return out
+
+    @property
+    def depth(self) -> int:
+        return len(self.levels) - 1
+
+
+class MerkleBackend(abc.ABC):
+    """Batched tree building + branch verification."""
+
+    @abc.abstractmethod
+    def _hash_batch(self, msgs: np.ndarray) -> np.ndarray:
+        """(B, L) uint8 -> (B, 32) uint8."""
+
+    # -- building ----------------------------------------------------
+
+    def build(self, shards: np.ndarray) -> MerkleTree:
+        """(N, L) uint8 shard matrix -> tree over N leaves."""
+        return self.build_batch(shards[None])[0]
+
+    def build_batch(self, shards: np.ndarray) -> List[MerkleTree]:
+        """(B, N, L) -> B trees, all leaf hashing/level hashing batched."""
+        b, n, l = shards.shape
+        p = _next_pow2(n)
+        prefixed = np.concatenate(
+            [
+                np.zeros((b * n, 1), dtype=np.uint8),
+                shards.reshape(b * n, l),
+            ],
+            axis=1,
+        )
+        leaf_dig = self._hash_batch(prefixed).reshape(b, n, 32)
+        if p != n:
+            pad = np.broadcast_to(
+                np.frombuffer(_EMPTY_LEAF_DIGEST, dtype=np.uint8), (b, p - n, 32)
+            )
+            leaf_dig = np.concatenate([leaf_dig, pad], axis=1)
+        levels = [leaf_dig]
+        width = p
+        while width > 1:
+            cur = levels[-1]  # (b, width, 32)
+            pairs = cur.reshape(b, width // 2, 64)
+            msgs = np.concatenate(
+                [
+                    np.ones((b * (width // 2), 1), dtype=np.uint8),
+                    pairs.reshape(b * (width // 2), 64),
+                ],
+                axis=1,
+            )
+            levels.append(self._hash_batch(msgs).reshape(b, width // 2, 32))
+            width //= 2
+        return [
+            MerkleTree([lvl[i] for lvl in levels], n_leaves=n) for i in range(b)
+        ]
+
+    # -- verification ------------------------------------------------
+
+    def verify_branch(
+        self, root: bytes, leaf: bytes, branch: Sequence[bytes], index: int
+    ) -> bool:
+        if branch:
+            branches = np.stack(
+                [np.frombuffer(s, dtype=np.uint8) for s in branch]
+            )[None]
+        else:  # single-leaf tree: root is the leaf digest
+            branches = np.zeros((1, 0, 32), dtype=np.uint8)
+        ok = self.verify_batch(
+            np.frombuffer(root, dtype=np.uint8)[None],
+            np.frombuffer(leaf, dtype=np.uint8)[None],
+            branches,
+            np.array([index]),
+        )
+        return bool(ok[0])
+
+    def verify_batch(
+        self,
+        roots: np.ndarray,
+        leaves: np.ndarray,
+        branches: np.ndarray,
+        indices: np.ndarray,
+    ) -> np.ndarray:
+        """Verify B branches at once.
+
+        roots (B, 32), leaves (B, L) raw shard bytes, branches
+        (B, D, 32) sibling paths bottom-up, indices (B,) leaf positions
+        -> (B,) bool.  The whole thing is D+1 batched hash dispatches.
+        """
+        b, l = leaves.shape
+        d = branches.shape[1]
+        prefixed = np.concatenate(
+            [np.zeros((b, 1), dtype=np.uint8), leaves], axis=1
+        )
+        cur = self._hash_batch(prefixed)  # (B, 32)
+        idx = np.asarray(indices).copy()
+        for lvl in range(d):
+            sib = branches[:, lvl]
+            bit = (idx & 1).astype(bool)[:, None]
+            left = np.where(bit, sib, cur)
+            right = np.where(bit, cur, sib)
+            msgs = np.concatenate(
+                [np.ones((b, 1), dtype=np.uint8), left, right], axis=1
+            )
+            cur = self._hash_batch(msgs)
+            idx >>= 1
+        return (cur == roots).all(axis=1)
+
+
+class CpuMerkle(MerkleBackend):
+    """hashlib reference backend."""
+
+    def _hash_batch(self, msgs: np.ndarray) -> np.ndarray:
+        return np.stack(
+            [
+                np.frombuffer(
+                    hashlib.sha256(m.tobytes()).digest(), dtype=np.uint8
+                )
+                for m in msgs
+            ]
+        )
+
+
+class XlaMerkle(MerkleBackend):
+    """Batched SHA-256 on TPU (sha256_xla.sha256_batch).
+
+    The batch axis is padded to the next power of two (min 8) so the
+    jitted kernel compiles once per (bucket, length) instead of once
+    per exact batch size — tree building halves the batch every level
+    and would otherwise retrace each one.
+    """
+
+    def _hash_batch(self, msgs: np.ndarray) -> np.ndarray:
+        import jax.numpy as jnp
+
+        from cleisthenes_tpu.ops.sha256_xla import sha256_batch
+
+        b = msgs.shape[0]
+        bucket = 8
+        while bucket < b:
+            bucket <<= 1
+        if bucket != b:
+            msgs = np.concatenate(
+                [msgs, np.zeros((bucket - b, msgs.shape[1]), dtype=np.uint8)]
+            )
+        return np.asarray(sha256_batch(jnp.asarray(msgs)))[:b]
+
+
+def make_merkle(backend: str) -> MerkleBackend:
+    if backend == "cpu":
+        return CpuMerkle()
+    if backend == "tpu":
+        return XlaMerkle()
+    raise ValueError(f"unknown merkle backend {backend!r}")
+
+
+__all__ = [
+    "MerkleTree",
+    "MerkleBackend",
+    "CpuMerkle",
+    "XlaMerkle",
+    "make_merkle",
+]

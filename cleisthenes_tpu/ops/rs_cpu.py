@@ -8,7 +8,6 @@ until the native C++ backend supersedes it for speed.
 from __future__ import annotations
 
 import functools
-from typing import Sequence
 
 import numpy as np
 
@@ -20,6 +19,11 @@ class CpuErasureCoder(ErasureCoder):
     def __init__(self, n: int, k: int):
         super().__init__(n, k)
         self.matrix = gf256.systematic_rs_matrix(n, k)
+        # Per-instance cache of decode matrices by erasure pattern
+        # (class-level lru_cache would pin instances alive forever).
+        self._decode_matrix = functools.lru_cache(maxsize=512)(
+            self._decode_matrix_impl
+        )
 
     def encode(self, data: np.ndarray) -> np.ndarray:
         data = np.ascontiguousarray(data, dtype=np.uint8)
@@ -29,18 +33,8 @@ class CpuErasureCoder(ErasureCoder):
         parity = gf256.gf_matmul(self.matrix[self.k :], data)
         return np.concatenate([data, parity], axis=0)
 
-    @functools.lru_cache(maxsize=512)
-    def _decode_matrix(self, indices: tuple) -> np.ndarray:
+    def _decode_matrix_impl(self, indices: tuple) -> np.ndarray:
         return gf256.gf_mat_inv(self.matrix[list(indices)])
 
-    def decode(self, indices: Sequence[int], shards: np.ndarray) -> np.ndarray:
-        indices = tuple(int(i) for i in indices)
-        if len(indices) != self.k or len(set(indices)) != self.k:
-            raise ValueError(
-                f"need exactly k={self.k} distinct shard indices, got {indices}"
-            )
-        shards = np.ascontiguousarray(shards, dtype=np.uint8)
-        assert shards.shape[0] == self.k, shards.shape
-        if indices == tuple(range(self.k)):
-            return shards.copy()
+    def _decode_impl(self, indices: tuple, shards: np.ndarray) -> np.ndarray:
         return gf256.gf_matmul(self._decode_matrix(indices), shards)

@@ -79,6 +79,11 @@ class XlaErasureCoder(ErasureCoder):
         self._g_enc = jnp.asarray(
             gf256.lift_to_bits(self.matrix[k:]), dtype=jnp.bfloat16
         )
+        # Per-instance cache of lifted decode matrices by erasure
+        # pattern (class-level lru_cache would pin instances alive).
+        self._decode_bits = functools.lru_cache(maxsize=512)(
+            self._decode_bits_impl
+        )
 
     def encode(self, data: np.ndarray) -> np.ndarray:
         data = np.ascontiguousarray(data, dtype=np.uint8)
@@ -87,21 +92,11 @@ class XlaErasureCoder(ErasureCoder):
             return data.copy()
         return np.asarray(_encode_kernel(self._g_enc, jnp.asarray(data)))
 
-    @functools.lru_cache(maxsize=512)
-    def _decode_bits(self, indices: tuple) -> jnp.ndarray:
+    def _decode_bits_impl(self, indices: tuple) -> jnp.ndarray:
         inv = gf256.gf_mat_inv(self.matrix[list(indices)])
         return jnp.asarray(gf256.lift_to_bits(inv), dtype=jnp.bfloat16)
 
-    def decode(self, indices: Sequence[int], shards: np.ndarray) -> np.ndarray:
-        indices = tuple(int(i) for i in indices)
-        if len(indices) != self.k or len(set(indices)) != self.k:
-            raise ValueError(
-                f"need exactly k={self.k} distinct shard indices, got {indices}"
-            )
-        shards = np.ascontiguousarray(shards, dtype=np.uint8)
-        assert shards.shape[0] == self.k, shards.shape
-        if indices == tuple(range(self.k)):
-            return shards.copy()
+    def _decode_impl(self, indices: tuple, shards: np.ndarray) -> np.ndarray:
         return np.asarray(
             _decode_kernel(self._decode_bits(indices), jnp.asarray(shards))
         )
@@ -118,7 +113,10 @@ class XlaErasureCoder(ErasureCoder):
     ) -> np.ndarray:
         shards = np.ascontiguousarray(shards, dtype=np.uint8)
         g = jnp.stack(
-            [self._decode_bits(tuple(int(i) for i in ix)) for ix in indices]
+            [
+                self._decode_bits(self._normalize_indices(ix))
+                for ix in indices
+            ]
         )
         return np.asarray(_decode_kernel_batch(g, jnp.asarray(shards)))
 

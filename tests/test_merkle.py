@@ -1,0 +1,115 @@
+"""SHA-256 + Merkle forest tests: correctness vs hashlib, branch
+verification incl. tamper cases (the validateMessage matrix from
+reference rbc/rbc_internal_test.go:5-31, docs/RBC-EN.md:35-38)."""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from cleisthenes_tpu.ops.merkle import CpuMerkle, XlaMerkle, make_merkle
+
+rng = np.random.default_rng(7)
+
+
+class TestSha256Xla:
+    @pytest.mark.parametrize("length", [0, 1, 31, 32, 55, 56, 63, 64, 65, 127, 200, 1000])
+    def test_matches_hashlib(self, length):
+        import jax.numpy as jnp
+
+        from cleisthenes_tpu.ops.sha256_xla import sha256_batch
+
+        msgs = rng.integers(0, 256, (5, length)).astype(np.uint8)
+        got = np.asarray(sha256_batch(jnp.asarray(msgs)))
+        for i in range(5):
+            want = hashlib.sha256(msgs[i].tobytes()).digest()
+            assert got[i].tobytes() == want, f"len={length} row={i}"
+
+    def test_known_vector(self):
+        import jax.numpy as jnp
+
+        from cleisthenes_tpu.ops.sha256_xla import sha256_batch
+
+        msg = np.frombuffer(b"abc", dtype=np.uint8)[None]
+        got = np.asarray(sha256_batch(jnp.asarray(msg)))[0].tobytes()
+        assert got.hex() == (
+            "ba7816bf8f01cfea414140de5dae2223b00361a396177a9cb410ff61f20015ad"
+        )
+
+
+@pytest.mark.parametrize("backend", ["cpu", "tpu"])
+class TestMerkle:
+    @pytest.mark.parametrize("n", [1, 2, 3, 4, 7, 16])
+    def test_build_and_verify_all_branches(self, backend, n):
+        m = make_merkle(backend)
+        shards = rng.integers(0, 256, (n, 64)).astype(np.uint8)
+        tree = m.build(shards)
+        for j in range(n):
+            assert m.verify_branch(
+                tree.root, shards[j].tobytes(), tree.branch(j), j
+            ), f"branch {j} of {n}"
+
+    def test_tampered_leaf_rejected(self, backend, n=7):
+        m = make_merkle(backend)
+        shards = rng.integers(0, 256, (n, 64)).astype(np.uint8)
+        tree = m.build(shards)
+        bad = bytearray(shards[3].tobytes())
+        bad[0] ^= 1
+        assert not m.verify_branch(tree.root, bytes(bad), tree.branch(3), 3)
+
+    def test_wrong_index_rejected(self, backend, n=8):
+        m = make_merkle(backend)
+        shards = rng.integers(0, 256, (n, 32)).astype(np.uint8)
+        tree = m.build(shards)
+        assert not m.verify_branch(
+            tree.root, shards[3].tobytes(), tree.branch(3), 4
+        )
+
+    def test_tampered_branch_rejected(self, backend, n=4):
+        m = make_merkle(backend)
+        shards = rng.integers(0, 256, (n, 32)).astype(np.uint8)
+        tree = m.build(shards)
+        branch = tree.branch(0)
+        branch[1] = b"\x00" * 32
+        assert not m.verify_branch(tree.root, shards[0].tobytes(), branch, 0)
+
+    def test_batch_build_matches_single(self, backend):
+        m = make_merkle(backend)
+        shards = rng.integers(0, 256, (5, 7, 48)).astype(np.uint8)
+        trees = m.build_batch(shards)
+        for i, t in enumerate(trees):
+            assert t.root == m.build(shards[i]).root
+
+    def test_batch_verify(self, backend):
+        """The ECHO hot path: many (root, leaf, branch, index) checks in
+        one dispatch, including some invalid ones."""
+        m = make_merkle(backend)
+        n = 8
+        shards = rng.integers(0, 256, (n, 64)).astype(np.uint8)
+        tree = m.build(shards)
+        roots = np.stack([np.frombuffer(tree.root, dtype=np.uint8)] * n)
+        leaves = shards.copy()
+        branches = np.stack(
+            [
+                np.stack([np.frombuffer(s, dtype=np.uint8) for s in tree.branch(j)])
+                for j in range(n)
+            ]
+        )
+        indices = np.arange(n)
+        leaves[2] ^= 0xFF  # corrupt one
+        ok = m.verify_batch(roots, leaves, branches, indices)
+        want = np.ones(n, dtype=bool)
+        want[2] = False
+        assert np.array_equal(ok, want)
+
+
+def test_backends_identical_roots():
+    shards = rng.integers(0, 256, (7, 128)).astype(np.uint8)
+    assert CpuMerkle().build(shards).root == XlaMerkle().build(shards).root
+
+
+def test_branch_index_out_of_range():
+    m = CpuMerkle()
+    tree = m.build(rng.integers(0, 256, (4, 16)).astype(np.uint8))
+    with pytest.raises(IndexError):
+        tree.branch(4)
