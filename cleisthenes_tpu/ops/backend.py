@@ -106,6 +106,19 @@ class BatchCrypto:
         self.erasure = make_erasure_coder(backend, n, k)
         self.merkle = make_merkle(backend)
 
+    def tpke(self, pub):
+        """Threshold-decryption service bound to this backend
+        (pub: tpke.ThresholdPublicKey)."""
+        from cleisthenes_tpu.ops.tpke import Tpke
+
+        return Tpke(pub, backend=self.backend)
+
+    def coin(self, pub):
+        """Common-coin service bound to this backend."""
+        from cleisthenes_tpu.ops.coin import CommonCoin
+
+        return CommonCoin(pub, backend=self.backend)
+
 
 def get_backend(config) -> BatchCrypto:
     # k comes from Config.data_shards, the single source of the

@@ -1,0 +1,78 @@
+"""Threshold common coin for BBA.
+
+The reference specifies (but does not implement) a network-global
+random bit per BBA round, "built in such a way that the correct
+processes need to cooperate to compute the value of each bit"
+(reference docs/BBA-EN.md:163-177) — i.e. a threshold-cryptographic
+coin, costed at ~4N^2 signature sharings per node per epoch
+(docs/HONEYBADGER-EN.md:93-94).
+
+Construction: a DDH-based threshold VUF over the same group as TPKE.
+For coin id C, let x = hash_to_group(C) (unknown discrete log).  Each
+node publishes share d_i = x^{s_i} with a Chaum-Pedersen proof; any
+f+1 verified shares Lagrange-combine to the unique value x^s, and the
+coin bit is a hash of it.  Unpredictable until f+1 nodes cooperate,
+and identical at every correct node — exactly the two properties
+docs/BBA-EN.md:174-177 demands.  Share verification batches across
+shares (and across concurrent BBA instances) in one TPU dispatch via
+ops/modmath.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import List, Sequence
+
+from cleisthenes_tpu.ops import tpke
+from cleisthenes_tpu.ops.tpke import (
+    DhShare,
+    ThresholdPublicKey,
+    ThresholdSecretShare,
+)
+
+
+def coin_base(coin_id: bytes) -> int:
+    """The group element x = H2G(coin_id) whose s-th power is the coin."""
+    return tpke.hash_to_group(b"coin|" + coin_id)
+
+
+class CommonCoin:
+    """One coin key set shared by all BBA instances of a network."""
+
+    def __init__(self, pub: ThresholdPublicKey, backend: str = "cpu"):
+        self.pub = pub
+        self.backend = backend
+
+    def share(
+        self, secret: ThresholdSecretShare, coin_id: bytes
+    ) -> DhShare:
+        return tpke.issue_share(secret, coin_base(coin_id), b"coin|" + coin_id)
+
+    def verify_shares(
+        self, coin_id: bytes, shares: Sequence[DhShare]
+    ) -> List[bool]:
+        return tpke.verify_shares(
+            self.pub,
+            coin_base(coin_id),
+            shares,
+            b"coin|" + coin_id,
+            self.backend,
+        )
+
+    def combine(self, coin_id: bytes, shares: Sequence[DhShare]) -> int:
+        """Full 256-bit coin value from >= f+1 verified shares."""
+        val = tpke.combine_shares(shares, self.pub.threshold)
+        return int.from_bytes(
+            hashlib.sha256(
+                b"coinval|" + coin_id + val.to_bytes(32, "big")
+            ).digest(),
+            "big",
+        )
+
+    def toss(self, coin_id: bytes, shares: Sequence[DhShare]) -> bool:
+        """The single random bit BBA phase 3 consumes
+        (docs/BBA-EN.md:163-181)."""
+        return bool(self.combine(coin_id, shares) & 1)
+
+
+__all__ = ["CommonCoin", "coin_base"]

@@ -1,0 +1,165 @@
+"""Threshold encryption, common coin, and the Montgomery mod-engine.
+
+Covers the TPKE.SetUp/Encrypt/DecShare/Decrypt API matrix
+(reference docs/THRESHOLD_ENCRYPTION-EN.md:33-36), Byzantine-share
+rejection, and coin agreement/unpredictability properties
+(docs/BBA-EN.md:163-181), on both backends.
+"""
+
+import random
+
+import pytest
+
+from cleisthenes_tpu.ops import coin as coin_mod
+from cleisthenes_tpu.ops import modmath as mm
+from cleisthenes_tpu.ops import tpke
+
+rng = random.Random(99)
+
+
+class TestModEngine:
+    def test_pow_batch_tpu_matches_pow(self):
+        eng = mm.ModEngine("tpu")
+        bases = [rng.randrange(2, mm.P) for _ in range(9)]
+        exps = [rng.randrange(mm.Q) for _ in range(9)]
+        assert eng.pow_batch(bases, exps) == [
+            pow(b, e, mm.P) for b, e in zip(bases, exps)
+        ]
+
+    def test_dual_pow_batch_tpu(self):
+        eng = mm.ModEngine("tpu")
+        u1 = [rng.randrange(2, mm.P) for _ in range(5)]
+        u2 = [rng.randrange(2, mm.P) for _ in range(5)]
+        e1 = [rng.randrange(mm.Q) for _ in range(5)]
+        e2 = [rng.randrange(mm.Q) for _ in range(5)]
+        assert eng.dual_pow_batch(u1, e1, u2, e2) == [
+            pow(a, x, mm.P) * pow(b, y, mm.P) % mm.P
+            for a, x, b, y in zip(u1, e1, u2, e2)
+        ]
+
+    def test_edge_exponents(self):
+        eng = mm.ModEngine("tpu")
+        assert eng.pow_batch([7, 7, 0, 1, mm.P - 1], [0, 1, 5, 9, 2]) == [
+            1, 7, 0, 1, pow(mm.P - 1, 2, mm.P)
+        ]
+
+    def test_empty_batch(self):
+        assert mm.ModEngine("tpu").pow_batch([], []) == []
+
+    def test_limb_roundtrip(self):
+        for _ in range(20):
+            x = rng.randrange(mm.P)
+            assert mm.limbs_to_int(mm.int_to_limbs(x)) == x
+
+
+class TestShamir:
+    def test_lagrange_recovers_secret(self):
+        secret = rng.randrange(mm.Q)
+        shares = tpke._shamir_shares(
+            secret, 7, 3, lambda k: rng.randbytes(k)
+        )
+        xs = [2, 5, 7]
+        lams = tpke.lagrange_coeff_at_zero(xs)
+        got = sum(l * shares[x - 1] for l, x in zip(lams, xs)) % mm.Q
+        assert got == secret
+
+    def test_fewer_than_threshold_insufficient(self):
+        # t-1 shares give a different (wrong) interpolation
+        secret = rng.randrange(mm.Q)
+        shares = tpke._shamir_shares(secret, 7, 3, lambda k: rng.randbytes(k))
+        xs = [1, 4]
+        lams = tpke.lagrange_coeff_at_zero(xs)
+        got = sum(l * shares[x - 1] for l, x in zip(lams, xs)) % mm.Q
+        assert got != secret
+
+
+@pytest.mark.parametrize("backend", ["cpu", "tpu"])
+class TestTpke:
+    def _setup(self, backend, n=4, f=1, seed=5):
+        pub, shares = tpke.deal(n, f + 1, seed=seed)
+        return tpke.Tpke(pub, backend=backend), shares
+
+    def test_encrypt_decrypt_roundtrip(self, backend):
+        svc, shares = self._setup(backend)
+        msg = b"proposal for epoch 9: " + bytes(range(100))
+        ct = svc.encrypt(msg)
+        dec = [svc.dec_share(s, ct) for s in shares]
+        ok = svc.verify_dec_shares(ct, dec)
+        assert ok == [True] * 4
+        # any f+1 = 2 shares decrypt
+        assert svc.combine(ct, [dec[1], dec[3]]) == msg
+        assert svc.combine(ct, [dec[0], dec[2]]) == msg
+
+    def test_bad_share_rejected(self, backend):
+        svc, shares = self._setup(backend)
+        ct = svc.encrypt(b"secret")
+        good = svc.dec_share(shares[0], ct)
+        forged = tpke.DhShare(index=2, d=good.d, e=good.e, z=good.z)
+        wrong_d = tpke.DhShare(
+            index=good.index, d=pow(good.d, 2, mm.P), e=good.e, z=good.z
+        )
+        oob = tpke.DhShare(index=99, d=good.d, e=good.e, z=good.z)
+        ok = svc.verify_dec_shares(ct, [good, forged, wrong_d, oob])
+        assert ok == [True, False, False, False]
+
+    def test_share_for_other_ciphertext_rejected(self, backend):
+        svc, shares = self._setup(backend)
+        ct1 = svc.encrypt(b"one")
+        ct2 = svc.encrypt(b"two")
+        d1 = svc.dec_share(shares[0], ct1)
+        assert svc.verify_dec_shares(ct2, [d1]) == [False]
+
+    def test_tampered_ciphertext_fails_integrity(self, backend):
+        svc, shares = self._setup(backend)
+        ct = svc.encrypt(b"payload")
+        bad = tpke.Ciphertext(
+            c1=ct.c1, c2=bytes([ct.c2[0] ^ 1]) + ct.c2[1:], tag=ct.tag
+        )
+        dec = [svc.dec_share(s, bad) for s in shares[:2]]
+        with pytest.raises(ValueError, match="integrity"):
+            svc.combine(bad, dec)
+
+    def test_too_few_shares_raises(self, backend):
+        svc, shares = self._setup(backend)
+        ct = svc.encrypt(b"x")
+        with pytest.raises(ValueError, match="need >="):
+            svc.combine(ct, [svc.dec_share(shares[0], ct)])
+
+
+@pytest.mark.parametrize("backend", ["cpu", "tpu"])
+class TestCommonCoin:
+    def test_agreement_across_share_subsets(self, backend):
+        n, f = 7, 2
+        pub, shares = tpke.deal(n, f + 1, seed=11)
+        c = coin_mod.CommonCoin(pub, backend=backend)
+        cid = b"epoch3|proposer5|round0"
+        all_shares = [c.share(s, cid) for s in shares]
+        assert c.verify_shares(cid, all_shares) == [True] * n
+        v1 = c.combine(cid, all_shares[:3])
+        v2 = c.combine(cid, all_shares[4:7])
+        v3 = c.combine(cid, [all_shares[0], all_shares[3], all_shares[6]])
+        assert v1 == v2 == v3
+
+    def test_different_ids_differ(self, backend):
+        pub, shares = tpke.deal(4, 2, seed=12)
+        c = coin_mod.CommonCoin(pub, backend=backend)
+        vals = set()
+        for r in range(8):
+            cid = b"round|%d" % r
+            sh = [c.share(s, cid) for s in shares[:2]]
+            vals.add(c.toss(cid, sh))
+        assert vals == {True, False}  # 8 tosses, both outcomes seen
+
+    def test_bad_coin_share_rejected(self, backend):
+        pub, shares = tpke.deal(4, 2, seed=13)
+        c = coin_mod.CommonCoin(pub, backend=backend)
+        cid = b"cid"
+        good = c.share(shares[0], cid)
+        evil = tpke.DhShare(index=1, d=good.d, e=good.e, z=(good.z + 1) % mm.Q)
+        assert c.verify_shares(cid, [good, evil]) == [True, False]
+
+
+def test_keys_distinct_between_tpke_and_coin_seeds():
+    pub_a, _ = tpke.deal(4, 2, seed=1)
+    pub_b, _ = tpke.deal(4, 2, seed=2)
+    assert pub_a.master != pub_b.master
