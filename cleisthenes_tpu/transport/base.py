@@ -1,0 +1,192 @@
+"""The Connection / Broadcaster / Handler seam + message authentication.
+
+Mirrors reference conn.go: ``Handler.ServeRequest(msg)`` (conn.go:27-29),
+the ``Connection`` interface ``{Send, Ip, Id, Close, Start, Handle}``
+(conn.go:31-38), ``Broadcaster`` (conn.go:182-184) and
+``ConnectionPool.{GetAll, Broadcast, Add, Remove}`` (conn.go:186-216).
+Two deliberate upgrades over the reference:
+
+- ``ConnectionPool`` is lock-guarded — the reference's pool map is the
+  one shared structure it forgot to lock (SURVEY.md §5.2 "known gap",
+  conn.go:186-216).
+- ``verify`` is real: the reference's envelope has a ``signature``
+  field but its check is a TODO returning true (conn.go:134-137);
+  here an ``Authenticator`` seam MACs the envelope
+  (HMAC-SHA256 over transport.message.signing_bytes).
+"""
+
+from __future__ import annotations
+
+import abc
+import hashlib
+import hmac
+import threading
+from typing import Callable, Dict, List, Optional, Protocol, runtime_checkable
+
+from cleisthenes_tpu.transport.message import Message, signing_bytes
+
+
+@runtime_checkable
+class Handler(Protocol):
+    """Reference conn.go:27-29."""
+
+    def serve_request(self, msg: Message) -> None: ...
+
+
+@runtime_checkable
+class Connection(Protocol):
+    """Reference conn.go:31-38.  ``send`` is fire-and-forget with
+    optional delivery callbacks (conn.go:66-77)."""
+
+    def send(
+        self,
+        msg: Message,
+        on_success: Optional[Callable[[Message], None]] = None,
+        on_err: Optional[Callable[[Exception], None]] = None,
+    ) -> None: ...
+
+    def id(self) -> str: ...
+
+    def close(self) -> None: ...
+
+    def start(self) -> None: ...
+
+    def handle(self, handler: Handler) -> None: ...
+
+
+class Broadcaster(Protocol):
+    """Reference conn.go:182-184 — the only transport dependency the
+    protocol layer has (rbc/rbc.go:35, bba/bba.go:60)."""
+
+    def broadcast(self, msg: Message) -> None: ...
+
+    def send_to(self, conn_id: str, msg: Message) -> None: ...
+
+
+# ---------------------------------------------------------------------------
+# Authentication (the implemented version of conn.go:134-137's TODO)
+# ---------------------------------------------------------------------------
+
+
+class Authenticator(abc.ABC):
+    """Signs and verifies envelope MACs."""
+
+    @abc.abstractmethod
+    def sign(self, msg: Message) -> Message:
+        """Return a copy of ``msg`` with the signature field filled."""
+
+    @abc.abstractmethod
+    def verify(self, msg: Message) -> bool: ...
+
+
+class NullAuthenticator(Authenticator):
+    """Reference-faithful stand-in: accept everything
+    (conn.go:134-137 behavior, for benchmarks isolating crypto cost)."""
+
+    def sign(self, msg: Message) -> Message:
+        return msg
+
+    def verify(self, msg: Message) -> bool:
+        return True
+
+
+class HmacAuthenticator(Authenticator):
+    """HMAC-SHA256 over the envelope with per-sender derived keys.
+
+    Key for sender i is HKDF-style ``H(master || sender_id)`` so a MAC
+    authenticates the claimed ``sender_id``, preventing one roster
+    member from impersonating another (the property the reference's
+    empty ``verify`` was meant to provide).  The master secret is part
+    of the trusted-dealer setup alongside the TPKE/coin keys.
+    """
+
+    def __init__(self, master_secret: bytes, self_id: str):
+        self._master = master_secret
+        self._self_id = self_id
+
+    def _key_for(self, sender_id: str) -> bytes:
+        return hashlib.sha256(
+            b"mac|" + self._master + b"|" + sender_id.encode("utf-8")
+        ).digest()
+
+    def sign(self, msg: Message) -> Message:
+        if msg.sender_id != self._self_id:
+            # a mismatch would produce messages every receiver silently
+            # rejects (MAC keyed by self_id, verified by sender_id)
+            raise ValueError(
+                f"cannot sign as {msg.sender_id!r}: this authenticator "
+                f"holds the key for {self._self_id!r}"
+            )
+        mac = hmac.new(
+            self._key_for(self._self_id), signing_bytes(msg), hashlib.sha256
+        ).digest()
+        return Message(
+            sender_id=msg.sender_id,
+            timestamp=msg.timestamp,
+            payload=msg.payload,
+            signature=mac,
+        )
+
+    def verify(self, msg: Message) -> bool:
+        want = hmac.new(
+            self._key_for(msg.sender_id), signing_bytes(msg), hashlib.sha256
+        ).digest()
+        return hmac.compare_digest(want, msg.signature)
+
+
+# ---------------------------------------------------------------------------
+# ConnectionPool
+# ---------------------------------------------------------------------------
+
+
+class ConnectionPool:
+    """id -> Connection map with broadcast (reference conn.go:186-216),
+    lock-guarded (fixing the reference's unguarded map)."""
+
+    def __init__(self) -> None:
+        self._conns: Dict[str, Connection] = {}
+        self._lock = threading.RLock()
+
+    def add(self, conn: Connection) -> None:
+        with self._lock:
+            self._conns[conn.id()] = conn
+
+    def remove(self, conn_id: str) -> None:
+        """Reference conn.go:214-216."""
+        with self._lock:
+            self._conns.pop(conn_id, None)
+
+    def get(self, conn_id: str) -> Optional[Connection]:
+        with self._lock:
+            return self._conns.get(conn_id)
+
+    def get_all(self) -> List[Connection]:
+        """Reference conn.go:196-202 (GetAll)."""
+        with self._lock:
+            return list(self._conns.values())
+
+    def broadcast(self, msg: Message) -> None:
+        """Fire-and-forget send to every pooled peer
+        (reference conn.go:204-208)."""
+        for conn in self.get_all():
+            conn.send(msg)
+
+    def send_to(self, conn_id: str, msg: Message) -> None:
+        conn = self.get(conn_id)
+        if conn is not None:
+            conn.send(msg)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._conns)
+
+
+__all__ = [
+    "Handler",
+    "Connection",
+    "Broadcaster",
+    "Authenticator",
+    "NullAuthenticator",
+    "HmacAuthenticator",
+    "ConnectionPool",
+]

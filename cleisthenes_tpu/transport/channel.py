@@ -1,0 +1,233 @@
+"""In-process channel transport with a deterministic scheduler.
+
+The reference tests multi-node behavior without a cluster by wiring N
+in-proc ``Connection``s over a channel-loopback fake transport
+(``mock.StreamWrapper``, test/mock/stream.go:8-38; pattern described in
+SURVEY.md §4.3).  This module is that idea promoted to a first-class
+subsystem: a ``ChannelNetwork`` hosts any number of in-proc validators,
+every message crosses the real wire codec (encode -> bytes -> decode)
+and the real Authenticator, and delivery order is driven by a *seeded
+deterministic scheduler* so Byzantine interleavings are replayable —
+the asyncio-era answer to the reference's ``go test -race`` discipline
+(SURVEY.md §5.2, §5.4: "seeded deterministic scheduler to test
+Byzantine interleavings").
+
+Fault injection (SURVEY.md §5.3 "the mock stream is the natural
+injection point"): ``crash(node)``, ``partition(a, b)``, and an
+arbitrary ``fault_filter`` for message-level drop/tamper/reorder
+adversaries.
+"""
+
+from __future__ import annotations
+
+import collections
+import random
+import time
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from cleisthenes_tpu.transport.base import (
+    Authenticator,
+    Handler,
+    NullAuthenticator,
+)
+from cleisthenes_tpu.transport.message import (
+    Message,
+    decode_message,
+    encode_message,
+)
+
+# A fault filter sees (sender_id, receiver_id, wire_bytes) and returns
+# the bytes to deliver, or None to drop.  Tampering is modeled by
+# returning different bytes — which the Authenticator then catches.
+FaultFilter = Callable[[str, str, bytes], Optional[bytes]]
+
+
+class ChannelEndpoint:
+    """One validator's attachment to the network: its handler plus its
+    authenticator (signing outbound, verifying inbound)."""
+
+    def __init__(
+        self, node_id: str, handler: Handler, auth: Authenticator
+    ) -> None:
+        self.node_id = node_id
+        self.handler = handler
+        self.auth = auth
+        self.delivered = 0
+        self.rejected = 0  # failed MAC verification
+
+
+class ChannelConnection:
+    """The in-proc ``Connection``: send = enqueue onto the network
+    (reference conn.go:66-77 semantics, minus goroutines — delivery
+    happens when the scheduler runs)."""
+
+    def __init__(self, network: "ChannelNetwork", local_id: str, remote_id: str):
+        self._network = network
+        self._local_id = local_id
+        self._remote_id = remote_id
+        self._closed = False
+
+    def id(self) -> str:
+        return self._remote_id
+
+    def send(self, msg, on_success=None, on_err=None) -> None:
+        if self._closed:
+            if on_err is not None:
+                on_err(ConnectionError("connection closed"))
+            return
+        try:
+            self._network.post(self._local_id, self._remote_id, msg)
+        except Exception as exc:  # queue full / encode error
+            if on_err is not None:
+                on_err(exc)
+            return
+        if on_success is not None:
+            on_success(msg)
+
+    def close(self) -> None:
+        self._closed = True
+
+    def start(self) -> None:  # no reader loop needed in-proc
+        pass
+
+    def handle(self, handler) -> None:
+        """Rebind where THIS node processes inbound traffic
+        (reference conn.go:81-85: Handle sets the local dispatch target)."""
+        self._network.rebind_handler(self._local_id, handler)
+
+
+class ChannelNetwork:
+    """N in-proc validators + a deterministic message scheduler."""
+
+    def __init__(self, seed: Optional[int] = None, queue_capacity: int = 1_000_000):
+        # seed=None -> FIFO delivery; seed=int -> seeded random-order
+        # delivery (the adversarial asynchronous scheduler from
+        # docs/HONEYBADGER-EN.md:125-140's PBFT comparison).
+        self._rng = random.Random(seed) if seed is not None else None
+        self._endpoints: Dict[str, ChannelEndpoint] = {}
+        # FIFO mode uses a deque (O(1) popleft); seeded mode uses a
+        # list with swap-pop (O(1) uniform removal, order irrelevant)
+        self._pending = collections.deque() if seed is None else []
+        self._queue_capacity = queue_capacity
+        self._crashed: Set[str] = set()
+        self._partitions: Set[Tuple[str, str]] = set()
+        self.fault_filter: Optional[FaultFilter] = None
+        self.messages_posted = 0
+        self.bytes_posted = 0
+
+    # -- topology ----------------------------------------------------------
+
+    def join(
+        self,
+        node_id: str,
+        handler: Handler,
+        auth: Optional[Authenticator] = None,
+    ) -> None:
+        self._endpoints[node_id] = ChannelEndpoint(
+            node_id, handler, auth or NullAuthenticator()
+        )
+
+    def rebind_handler(self, node_id: str, handler: Handler) -> None:
+        self._endpoints[node_id].handler = handler
+
+    def connect(self, local_id: str, remote_id: str) -> ChannelConnection:
+        return ChannelConnection(self, local_id, remote_id)
+
+    def node_ids(self) -> List[str]:
+        return sorted(self._endpoints)
+
+    # -- fault injection ---------------------------------------------------
+
+    def crash(self, node_id: str) -> None:
+        """Fail-stop: node neither sends nor receives from now on."""
+        self._crashed.add(node_id)
+
+    def recover(self, node_id: str) -> None:
+        self._crashed.discard(node_id)
+
+    def partition(self, a: str, b: str) -> None:
+        """Drop all traffic between a and b (both directions)."""
+        self._partitions.add((a, b))
+        self._partitions.add((b, a))
+
+    def heal(self, a: str, b: str) -> None:
+        self._partitions.discard((a, b))
+        self._partitions.discard((b, a))
+
+    # -- message flow ------------------------------------------------------
+
+    def post(self, sender_id: str, receiver_id: str, msg: Message) -> None:
+        """Sign, encode and enqueue one message."""
+        if sender_id in self._crashed:
+            return
+        if len(self._pending) >= self._queue_capacity:
+            raise OverflowError("channel network queue full")
+        ep = self._endpoints.get(sender_id)
+        signed = ep.auth.sign(msg) if ep is not None else msg
+        wire = encode_message(signed)
+        self.messages_posted += 1
+        self.bytes_posted += len(wire)
+        self._pending.append((sender_id, receiver_id, wire))
+
+    def pending_count(self) -> int:
+        return len(self._pending)
+
+    def step(self) -> bool:
+        """Deliver one message; returns False if none pending.
+
+        Delivery order: FIFO without a seed, seeded-uniform-random with
+        one — every run with the same seed replays the identical
+        interleaving.
+        """
+        while self._pending:
+            if self._rng is None:
+                sender, receiver, wire = self._pending.popleft()
+            else:
+                idx = self._rng.randrange(len(self._pending))
+                item = self._pending[idx]
+                self._pending[idx] = self._pending[-1]
+                self._pending.pop()
+                sender, receiver, wire = item
+            if receiver in self._crashed or sender in self._crashed:
+                continue
+            if (sender, receiver) in self._partitions:
+                continue
+            if self.fault_filter is not None:
+                maybe = self.fault_filter(sender, receiver, wire)
+                if maybe is None:
+                    continue
+                wire = maybe
+            ep = self._endpoints.get(receiver)
+            if ep is None:
+                continue
+            try:
+                msg = decode_message(wire)
+            except ValueError:
+                ep.rejected += 1
+                continue
+            if not ep.auth.verify(msg):
+                # the implemented version of conn.go:134-137's TODO
+                ep.rejected += 1
+                continue
+            ep.delivered += 1
+            ep.handler.serve_request(msg)
+            return True
+        return False
+
+    def run(
+        self, max_steps: int = 10_000_000, deadline_s: Optional[float] = None
+    ) -> int:
+        """Deliver until quiescent (handlers may enqueue more while we
+        drain).  Returns the number of messages delivered."""
+        t0 = time.monotonic()
+        steps = 0
+        while steps < max_steps:
+            if deadline_s is not None and time.monotonic() - t0 > deadline_s:
+                break
+            if not self.step():
+                break
+            steps += 1
+        return steps
+
+
+__all__ = ["ChannelNetwork", "ChannelConnection", "ChannelEndpoint", "FaultFilter"]

@@ -1,0 +1,372 @@
+"""Wire format: the message envelope and typed payloads.
+
+Mirrors reference pb/message.proto: an envelope
+``Message{signature, timestamp, oneof payload{RBC, BBA}}``
+(message.proto:11-23) with ``RBC{payload bytes, type VAL|ECHO|READY}``
+(message.proto:25-35) and ``BBA{payload bytes, type BVAL|AUX}``
+(message.proto:37-46).  Inner request structs are marshalled into the
+``payload`` field exactly as the reference notes ("marshaled data by
+type", message.proto:27).
+
+Two payload kinds are added beyond the reference's proto — ``COIN``
+(threshold common-coin shares, specified at docs/BBA-EN.md:163-181 but
+never given a wire format) and ``DEC`` (TPKE decryption shares,
+docs/THRESHOLD_ENCRYPTION-EN.md:33-36) — because the reference never
+reached the point of needing them on the wire.
+
+The codec is a deliberate, self-contained binary framing (tag-length-
+value with fixed-width ints) rather than generated protobuf: it keeps
+the wire format dependency-free, deterministic byte-for-byte (needed
+for envelope MACs and replay tests), and trivially portable to the C++
+runtime.  The gRPC transport wraps these bytes in a single
+``bytes``-typed stream method, preserving the reference's
+one-bidi-stream-per-peer topology (message.proto:7-9).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import struct
+from typing import List, Optional, Tuple, Union
+
+_MAGIC = b"CLTP"  # cleisthenes-tpu wire magic
+_VERSION = 1
+
+# Hard cap on a decoded frame's declared sizes: a Byzantine peer must
+# not be able to make us allocate unbounded memory from a length field.
+MAX_FIELD_BYTES = 64 * 1024 * 1024
+
+
+class RbcType(enum.IntEnum):
+    """Reference pb/message.proto:29-34 (RBC.Type)."""
+
+    VAL = 0
+    ECHO = 1
+    READY = 2
+
+
+class BbaType(enum.IntEnum):
+    """Reference pb/message.proto:39-43 (BBA.Type)."""
+
+    BVAL = 0
+    AUX = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class RbcPayload:
+    """Reference pb/message.proto:25-35 + rbc/request.go:9-21.
+
+    ``proposer``: which RBC instance (one per proposing validator,
+    docs/HONEYBADGER-EN.md:85-89).  ``epoch``: HBBFT epoch.
+    VAL/ECHO carry (root_hash, branch, shard, shard_index)
+    (rbc/request.go:9-17); READY carries root_hash only
+    (rbc/request.go:19-21).
+    """
+
+    type: RbcType
+    proposer: str
+    epoch: int
+    root_hash: bytes = b""
+    branch: Tuple[bytes, ...] = ()
+    shard: bytes = b""
+    shard_index: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class BbaPayload:
+    """Reference pb/message.proto:37-46 + bba/request.go:6-13.
+
+    ``proposer``: which BBA instance.  ``round``: the internal BBA
+    round (bba/bba.go:45-46 keeps both epoch and round).  ``value``:
+    the binary (bvalRequest.Value / auxRequest.Value).
+    """
+
+    type: BbaType
+    proposer: str
+    epoch: int
+    round: int
+    value: bool
+
+
+@dataclasses.dataclass(frozen=True)
+class CoinPayload:
+    """Threshold common-coin share for one (instance, epoch, round)
+    (docs/BBA-EN.md:163-181; no reference wire format exists).
+
+    (index, d, e, z) is an ops.tpke.DhShare: share value plus its
+    Chaum-Pedersen validity proof.
+    """
+
+    proposer: str
+    epoch: int
+    round: int
+    index: int
+    d: int
+    e: int
+    z: int
+
+
+@dataclasses.dataclass(frozen=True)
+class DecSharePayload:
+    """TPKE decryption share for one proposer's ciphertext in one epoch
+    (docs/THRESHOLD_ENCRYPTION-EN.md:35, docs/HONEYBADGER-EN.md:61-65).
+    """
+
+    proposer: str
+    epoch: int
+    index: int
+    d: int
+    e: int
+    z: int
+
+
+Payload = Union[RbcPayload, BbaPayload, CoinPayload, DecSharePayload]
+
+# oneof discriminants (reference message.proto:18-22 has rbc=3, bba=4;
+# we keep those two numbers and extend)
+_KIND_RBC = 3
+_KIND_BBA = 4
+_KIND_COIN = 5
+_KIND_DEC = 6
+
+
+@dataclasses.dataclass(frozen=True)
+class Message:
+    """The envelope (reference pb/message.proto:11-23).
+
+    ``signature`` authenticates (sender_id, timestamp, payload) — the
+    field the reference declares (message.proto:14) but never checks
+    (conn.go:134-137 TODO); here it is a real MAC, see
+    transport.base.Authenticator.  ``sender_id`` is carried explicitly
+    because unlike the reference we authenticate it (the reference
+    trusts the connection's uuid, comm.go:46).
+    """
+
+    sender_id: str
+    timestamp: float
+    payload: Payload
+    signature: bytes = b""
+
+
+# ---------------------------------------------------------------------------
+# binary codec
+# ---------------------------------------------------------------------------
+
+
+def _pack_bytes(out: List[bytes], b: bytes) -> None:
+    out.append(struct.pack(">I", len(b)))
+    out.append(b)
+
+
+def _pack_str(out: List[bytes], s: str) -> None:
+    _pack_bytes(out, s.encode("utf-8"))
+
+
+def _pack_int(out: List[bytes], x: int) -> None:
+    """Arbitrary-precision non-negative int (group elements are 256-bit)."""
+    if x < 0:
+        raise ValueError("negative int on wire")
+    b = x.to_bytes((x.bit_length() + 7) // 8 or 1, "big")
+    _pack_bytes(out, b)
+
+
+class _Reader:
+    def __init__(self, data: bytes):
+        self._d = data
+        self._o = 0
+
+    def bytes_(self) -> bytes:
+        if self._o + 4 > len(self._d):
+            raise ValueError("truncated frame")
+        (n,) = struct.unpack_from(">I", self._d, self._o)
+        if n > MAX_FIELD_BYTES:
+            raise ValueError(f"field length {n} exceeds cap")
+        self._o += 4
+        if self._o + n > len(self._d):
+            raise ValueError("truncated frame")
+        out = self._d[self._o : self._o + n]
+        self._o += n
+        return out
+
+    def str_(self) -> str:
+        return self.bytes_().decode("utf-8")
+
+    def int_(self) -> int:
+        return int.from_bytes(self.bytes_(), "big")
+
+    def u8(self) -> int:
+        if self._o + 1 > len(self._d):
+            raise ValueError("truncated frame")
+        v = self._d[self._o]
+        self._o += 1
+        return v
+
+    def u32(self) -> int:
+        if self._o + 4 > len(self._d):
+            raise ValueError("truncated frame")
+        (v,) = struct.unpack_from(">I", self._d, self._o)
+        self._o += 4
+        return v
+
+    def u64(self) -> int:
+        if self._o + 8 > len(self._d):
+            raise ValueError("truncated frame")
+        (v,) = struct.unpack_from(">Q", self._d, self._o)
+        self._o += 8
+        return v
+
+    def f64(self) -> float:
+        if self._o + 8 > len(self._d):
+            raise ValueError("truncated frame")
+        (v,) = struct.unpack_from(">d", self._d, self._o)
+        self._o += 8
+        return v
+
+    def done(self) -> bool:
+        return self._o == len(self._d)
+
+
+def _encode_payload(p: Payload) -> Tuple[int, bytes]:
+    out: List[bytes] = []
+    if isinstance(p, RbcPayload):
+        out.append(struct.pack(">B", int(p.type)))
+        _pack_str(out, p.proposer)
+        out.append(struct.pack(">Q", p.epoch))
+        _pack_bytes(out, p.root_hash)
+        out.append(struct.pack(">I", len(p.branch)))
+        for b in p.branch:
+            _pack_bytes(out, b)
+        _pack_bytes(out, p.shard)
+        out.append(struct.pack(">I", p.shard_index))
+        return _KIND_RBC, b"".join(out)
+    if isinstance(p, BbaPayload):
+        out.append(struct.pack(">B", int(p.type)))
+        _pack_str(out, p.proposer)
+        out.append(struct.pack(">QQB", p.epoch, p.round, int(p.value)))
+        return _KIND_BBA, b"".join(out)
+    if isinstance(p, CoinPayload):
+        _pack_str(out, p.proposer)
+        out.append(struct.pack(">QQI", p.epoch, p.round, p.index))
+        _pack_int(out, p.d)
+        _pack_int(out, p.e)
+        _pack_int(out, p.z)
+        return _KIND_COIN, b"".join(out)
+    if isinstance(p, DecSharePayload):
+        _pack_str(out, p.proposer)
+        out.append(struct.pack(">QI", p.epoch, p.index))
+        _pack_int(out, p.d)
+        _pack_int(out, p.e)
+        _pack_int(out, p.z)
+        return _KIND_DEC, b"".join(out)
+    raise TypeError(f"unknown payload type {type(p)!r}")
+
+
+def _decode_payload(kind: int, data: bytes) -> Payload:
+    r = _Reader(data)
+    out = _decode_payload_inner(r, kind)
+    if not r.done():
+        # reject non-canonical bodies: the MAC covers the re-encoded
+        # canonical form, so trailing junk would make frames malleable
+        raise ValueError("trailing bytes in payload body")
+    return out
+
+
+def _decode_payload_inner(r: _Reader, kind: int) -> Payload:
+    if kind == _KIND_RBC:
+        t = RbcType(r.u8())
+        proposer = r.str_()
+        epoch = r.u64()
+        root = r.bytes_()
+        nbr = r.u32()
+        if nbr > 64:  # Merkle depth cap: 2^64 leaves is beyond any N
+            raise ValueError(f"branch length {nbr} exceeds cap")
+        branch = tuple(r.bytes_() for _ in range(nbr))
+        shard = r.bytes_()
+        idx = r.u32()
+        return RbcPayload(
+            type=t, proposer=proposer, epoch=epoch, root_hash=root,
+            branch=branch, shard=shard, shard_index=idx,
+        )
+    if kind == _KIND_BBA:
+        t = BbaType(r.u8())
+        proposer = r.str_()
+        epoch = r.u64()
+        rnd = r.u64()
+        val = bool(r.u8())
+        return BbaPayload(
+            type=t, proposer=proposer, epoch=epoch, round=rnd, value=val
+        )
+    if kind == _KIND_COIN:
+        proposer = r.str_()
+        epoch = r.u64()
+        rnd = r.u64()
+        idx = r.u32()
+        return CoinPayload(
+            proposer=proposer, epoch=epoch, round=rnd, index=idx,
+            d=r.int_(), e=r.int_(), z=r.int_(),
+        )
+    if kind == _KIND_DEC:
+        proposer = r.str_()
+        epoch = r.u64()
+        idx = r.u32()
+        return DecSharePayload(
+            proposer=proposer, epoch=epoch, index=idx,
+            d=r.int_(), e=r.int_(), z=r.int_(),
+        )
+    raise ValueError(f"unknown payload kind {kind}")
+
+
+def signing_bytes(msg: Message) -> bytes:
+    """The byte string the envelope MAC covers: everything except the
+    signature itself (the reference's intended-but-absent semantics,
+    message.proto:14, conn.go:134-137)."""
+    kind, body = _encode_payload(msg.payload)
+    out: List[bytes] = [_MAGIC, struct.pack(">BB", _VERSION, kind)]
+    _pack_str(out, msg.sender_id)
+    out.append(struct.pack(">d", msg.timestamp))
+    _pack_bytes(out, body)
+    return b"".join(out)
+
+
+def encode_message(msg: Message) -> bytes:
+    out = [signing_bytes(msg)]
+    _pack_bytes(out, msg.signature)
+    return b"".join(out)
+
+
+def decode_message(data: bytes) -> Message:
+    if len(data) < 6 or data[:4] != _MAGIC:
+        raise ValueError("bad magic")
+    version, kind = data[4], data[5]
+    if version != _VERSION:
+        raise ValueError(f"unsupported wire version {version}")
+    r = _Reader(data[6:])
+    sender = r.str_()
+    ts = r.f64()
+    body = r.bytes_()
+    sig = r.bytes_()
+    if not r.done():
+        raise ValueError("trailing bytes in frame")
+    return Message(
+        sender_id=sender,
+        timestamp=ts,
+        payload=_decode_payload(kind, body),
+        signature=sig,
+    )
+
+
+__all__ = [
+    "Message",
+    "Payload",
+    "RbcPayload",
+    "BbaPayload",
+    "CoinPayload",
+    "DecSharePayload",
+    "RbcType",
+    "BbaType",
+    "encode_message",
+    "decode_message",
+    "signing_bytes",
+    "MAX_FIELD_BYTES",
+]

@@ -1,0 +1,266 @@
+"""Transport layer tests: wire codec, authentication, channel network.
+
+Models the reference's conn/comm tests (conn_test.go:32-202,
+comm_test.go:27-96, SURVEY.md §4): full send -> wire -> verify ->
+dispatch round trips over the in-proc transport, plus the adversarial
+cases the reference's TODO ``verify`` (conn.go:134-137) could not test.
+"""
+
+import pytest
+
+from cleisthenes_tpu.transport import (
+    BbaPayload,
+    BbaType,
+    ChannelNetwork,
+    CoinPayload,
+    ConnectionPool,
+    DecSharePayload,
+    HmacAuthenticator,
+    Message,
+    RbcPayload,
+    RbcType,
+    decode_message,
+    encode_message,
+)
+
+
+def _payloads():
+    return [
+        RbcPayload(
+            type=RbcType.VAL,
+            proposer="node-2",
+            epoch=7,
+            root_hash=b"\x01" * 32,
+            branch=(b"\x02" * 32, b"\x03" * 32),
+            shard=bytes(range(200)),
+            shard_index=3,
+        ),
+        RbcPayload(type=RbcType.READY, proposer="n0", epoch=0, root_hash=b"r" * 32),
+        BbaPayload(type=BbaType.BVAL, proposer="n1", epoch=2, round=5, value=True),
+        BbaPayload(type=BbaType.AUX, proposer="n1", epoch=2, round=0, value=False),
+        CoinPayload(
+            proposer="n3", epoch=1, round=2, index=4, d=2**255 - 19, e=12345, z=0
+        ),
+        DecSharePayload(proposer="n0", epoch=9, index=1, d=1, e=2**200, z=7),
+    ]
+
+
+class TestCodec:
+    @pytest.mark.parametrize("payload", _payloads(), ids=lambda p: type(p).__name__)
+    def test_round_trip(self, payload):
+        msg = Message(
+            sender_id="node-9", timestamp=123.5, payload=payload, signature=b"sig"
+        )
+        out = decode_message(encode_message(msg))
+        assert out == msg
+
+    def test_bad_magic_rejected(self):
+        with pytest.raises(ValueError):
+            decode_message(b"XXXX\x01\x03" + b"\x00" * 32)
+
+    def test_truncated_rejected(self):
+        wire = encode_message(
+            Message("a", 0.0, RbcPayload(RbcType.READY, "p", 0, b"h"))
+        )
+        with pytest.raises(ValueError):
+            decode_message(wire[:-3])
+
+    def test_trailing_bytes_rejected(self):
+        wire = encode_message(
+            Message("a", 0.0, RbcPayload(RbcType.READY, "p", 0, b"h"))
+        )
+        with pytest.raises(ValueError):
+            decode_message(wire + b"x")
+
+    def test_oversized_length_field_rejected(self):
+        """A Byzantine length prefix must not drive allocation."""
+        wire = bytearray(
+            encode_message(Message("a", 0.0, RbcPayload(RbcType.READY, "p", 0, b"h")))
+        )
+        wire[6:10] = (2**31).to_bytes(4, "big")  # sender_id length field
+        with pytest.raises(ValueError):
+            decode_message(bytes(wire))
+
+
+class TestAuthenticator:
+    def test_sign_verify(self):
+        auth = HmacAuthenticator(b"master", "n0")
+        msg = auth.sign(Message("n0", 1.0, RbcPayload(RbcType.READY, "p", 0, b"h")))
+        assert msg.signature != b""
+        assert auth.verify(msg)
+
+    def test_tamper_detected(self):
+        auth = HmacAuthenticator(b"master", "n0")
+        msg = auth.sign(Message("n0", 1.0, RbcPayload(RbcType.READY, "p", 0, b"h")))
+        forged = Message("n0", 1.0, RbcPayload(RbcType.READY, "p", 1, b"h"), msg.signature)
+        assert not auth.verify(forged)
+
+    def test_impersonation_detected(self):
+        """A MAC made with n0's key must not authenticate a message
+        claiming sender n1 (key derivation binds the sender id)."""
+        import hashlib
+        import hmac as hmac_mod
+
+        from cleisthenes_tpu.transport.message import signing_bytes
+
+        msg = Message("n1", 1.0, RbcPayload(RbcType.READY, "p", 0, b"h"))
+        n0_key = hashlib.sha256(b"mac|" + b"master" + b"|" + b"n0").digest()
+        forged = Message(
+            msg.sender_id,
+            msg.timestamp,
+            msg.payload,
+            hmac_mod.new(n0_key, signing_bytes(msg), hashlib.sha256).digest(),
+        )
+        assert not HmacAuthenticator(b"master", "nX").verify(forged)
+
+    def test_sign_refuses_wrong_sender(self):
+        """sign() raises rather than emit a message every receiver
+        would silently reject."""
+        auth = HmacAuthenticator(b"master", "n0")
+        with pytest.raises(ValueError):
+            auth.sign(Message("n1", 1.0, RbcPayload(RbcType.READY, "p", 0, b"h")))
+
+    def test_payload_trailing_bytes_rejected(self):
+        """Non-canonical payload bodies (trailing junk inside the
+        length-prefixed body) must not decode — frame malleability."""
+        from cleisthenes_tpu.transport.message import (
+            _KIND_BBA,
+            _decode_payload,
+            _encode_payload,
+        )
+
+        kind, body = _encode_payload(
+            BbaPayload(BbaType.BVAL, "p", 0, 0, True)
+        )
+        assert kind == _KIND_BBA
+        _decode_payload(kind, body)  # canonical: fine
+        with pytest.raises(ValueError):
+            _decode_payload(kind, body + b"\x00")
+
+
+class _Collector:
+    def __init__(self):
+        self.got = []
+
+    def serve_request(self, msg):
+        self.got.append(msg)
+
+
+def _mk_net(n=3, seed=None, master=b"k"):
+    net = ChannelNetwork(seed=seed)
+    collectors = {}
+    for i in range(n):
+        nid = f"n{i}"
+        collectors[nid] = _Collector()
+        net.join(nid, collectors[nid], HmacAuthenticator(master, nid))
+    return net, collectors
+
+
+def _msg(sender, epoch=0):
+    return Message(sender, 0.0, RbcPayload(RbcType.READY, "p", epoch, b"h" * 32))
+
+
+class TestChannelNetwork:
+    def test_point_to_point_delivery(self):
+        net, col = _mk_net()
+        conn = net.connect("n0", "n1")
+        conn.send(_msg("n0"))
+        assert net.run() == 1
+        assert len(col["n1"].got) == 1
+        assert col["n1"].got[0].sender_id == "n0"
+
+    def test_pool_broadcast(self):
+        """Reference conn_test.go:138-202 (broadcast to the pool)."""
+        net, col = _mk_net(4)
+        pool = ConnectionPool()
+        for peer in ("n1", "n2", "n3"):
+            pool.add(net.connect("n0", peer))
+        pool.broadcast(_msg("n0"))
+        assert net.run() == 3
+        for peer in ("n1", "n2", "n3"):
+            assert len(col[peer].got) == 1
+        assert len(col["n0"].got) == 0
+
+    def test_tampered_wire_rejected(self):
+        net, col = _mk_net()
+
+        def flip(sender, receiver, wire):
+            w = bytearray(wire)
+            w[-1] ^= 0xFF  # corrupt MAC byte
+            return bytes(w)
+
+        net.fault_filter = flip
+        net.connect("n0", "n1").send(_msg("n0"))
+        net.run()
+        assert col["n1"].got == []
+        # rejection is visible for observability
+        assert net._endpoints["n1"].rejected == 1
+
+    def test_crash_drops_traffic(self):
+        net, col = _mk_net()
+        net.crash("n1")
+        net.connect("n0", "n1").send(_msg("n0"))
+        net.connect("n0", "n2").send(_msg("n0"))
+        net.run()
+        assert col["n1"].got == []
+        assert len(col["n2"].got) == 1
+
+    def test_partition_and_heal(self):
+        net, col = _mk_net()
+        net.partition("n0", "n1")
+        net.connect("n0", "n1").send(_msg("n0"))
+        net.run()
+        assert col["n1"].got == []
+        net.heal("n0", "n1")
+        net.connect("n0", "n1").send(_msg("n0"))
+        net.run()
+        assert len(col["n1"].got) == 1
+
+    def test_seeded_scheduler_is_replayable(self):
+        """Same seed -> identical adversarial interleaving (SURVEY §5.2)."""
+
+        def run_once(seed):
+            net, col = _mk_net(3, seed=seed)
+            for e in range(20):
+                net.connect("n0", "n2").send(_msg("n0", epoch=e))
+                net.connect("n1", "n2").send(_msg("n1", epoch=e))
+            net.run()
+            return [(m.sender_id, m.payload.epoch) for m in col["n2"].got]
+
+        a, b = run_once(42), run_once(42)
+        assert a == b
+        c = run_once(7)
+        assert sorted(a) == sorted(c)
+        assert a != c  # different seed, different order (40 msgs: collision ~0)
+
+    def test_handler_cascade_drains(self):
+        """Handlers that send more messages keep the scheduler busy
+        (the pattern every protocol round uses)."""
+        net = ChannelNetwork()
+
+        class Relay:
+            def __init__(self, nid, limit=5):
+                self.nid = nid
+                self.limit = limit
+                self.seen = 0
+
+            def serve_request(self, msg):
+                self.seen += 1
+                if msg.payload.epoch < self.limit:
+                    net.connect(self.nid, "n0" if self.nid == "n1" else "n1").send(
+                        Message(
+                            self.nid,
+                            0.0,
+                            RbcPayload(
+                                RbcType.READY, "p", msg.payload.epoch + 1, b"h"
+                            ),
+                        )
+                    )
+
+        r0, r1 = Relay("n0"), Relay("n1")
+        net.join("n0", r0)
+        net.join("n1", r1)
+        net.connect("n0", "n1").send(_msg("n0", epoch=0))
+        delivered = net.run()
+        assert delivered == 6  # epochs 0..5 ping-pong
+        assert r0.seen + r1.seen == 6
