@@ -1,0 +1,13 @@
+"""Protocol plane: RBC, BBA (+ common coin), ACS, HoneyBadger.
+
+The asynchronous, data-dependent control flow of HBBFT — the part XLA
+cannot host — lives here as host-side message-driven state machines,
+mirroring the reference's actor design (reference rbc/rbc.go,
+bba/bba.go, honeybadger.go).  All O(N^2) crypto math is delegated to
+the batched ops plane (cleisthenes_tpu.ops) through the BatchCrypto
+seam.
+"""
+
+from cleisthenes_tpu.protocol.rbc import RBC
+
+__all__ = ["RBC"]

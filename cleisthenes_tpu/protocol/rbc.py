@@ -1,0 +1,306 @@
+"""RBC: Bracha reliable broadcast with erasure coding + Merkle proofs.
+
+Completes the reference's all-panics skeleton (reference rbc/rbc.go:38-100)
+per its own spec (reference docs/RBC-EN.md:28-45):
+
+  propose:  split value into K = N-2f data shards, RS-encode to N
+            shards, build a Merkle tree over them, send VAL_j =
+            (root h, branch b(j), shard s(j)) to node j
+            (rbc/rbc.go:98-100 `shard`; docs/RBC-EN.md:28-33).
+  VAL:      (from the proposer only) verify the branch, multicast
+            ECHO with the same (h, b(j), s(j)) (docs/RBC-EN.md:34).
+  ECHO:     verify branch (rbc/rbc.go:93-95 `validateMessage`); on
+            N-f valid ECHOs interpolate from N-2f shards, *recompute
+            the root* to catch a Byzantine proposer, then send
+            READY(h) (rbc/rbc.go:88-90 `interpolate`;
+            docs/RBC-EN.md:35-39).
+  READY:    f+1 READY(h) -> send READY(h) if not yet sent; 2f+1
+            READY(h) + N-2f verified shards -> decode and deliver
+            (docs/RBC-EN.md:41-42).
+
+The RS encode/decode and Merkle build/verify are delegated to the
+BatchCrypto seam (ops.backend) so they run batched on TPU under
+``crypto_backend='tpu'`` — this module is pure control flow.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from cleisthenes_tpu.config import Config
+from cleisthenes_tpu.ops.backend import BatchCrypto
+from cleisthenes_tpu.ops.payload import join_payload, split_payload
+from cleisthenes_tpu.transport.message import RbcPayload, RbcType
+
+# Per-root shard length sanity cap (a Byzantine proposer must not make
+# honest nodes buffer huge shards; envelopes are separately capped by
+# transport.message.MAX_FIELD_BYTES).
+MAX_SHARD_BYTES = 16 * 1024 * 1024
+
+
+class RBC:
+    """One reliable-broadcast instance: (epoch, proposer).
+
+    Mirrors the reference struct (rbc/rbc.go:9-36): n, f, proposer, the
+    erasure codec, per-type bookkeeping, and a broadcaster — with the
+    request repositories realized as per-root dicts enforcing
+    one-vote-per-sender.
+    """
+
+    def __init__(
+        self,
+        *,
+        config: Config,
+        crypto: BatchCrypto,
+        epoch: int,
+        proposer: str,
+        owner: str,
+        member_ids: Sequence[str],
+        out,
+    ) -> None:
+        self.n = config.n
+        self.f = config.f
+        self.k = config.data_shards
+        self.epoch = epoch
+        self.proposer = proposer
+        self.owner = owner
+        self.members: List[str] = sorted(member_ids)
+        if len(self.members) != self.n:
+            raise ValueError(
+                f"roster size {len(self.members)} != n={self.n}"
+            )
+        self.crypto = crypto
+        self.out = out  # PayloadBroadcaster: broadcast / send_to
+
+        # hook set by ACS: fn(proposer_id, value_bytes)
+        self.on_deliver: Optional[Callable[[str, bytes], None]] = None
+
+        self._member_set = frozenset(self.members)
+        self._echo_sent = False
+        self._ready_root: Optional[bytes] = None  # root we READY'd
+        # One ECHO and one READY per sender per *instance* (a correct
+        # node sends exactly one of each; reference rbc/request.go:30-42
+        # repositories are keyed by ConnId).  This also bounds the
+        # number of distinct roots an instance ever tracks to n.
+        self._echo_voted: Set[str] = set()
+        self._ready_voted: Set[str] = set()
+        # root -> set of ECHO senders
+        self._echo_senders: Dict[bytes, Set[str]] = {}
+        # root -> shard_index -> shard bytes (branch-verified)
+        self._shards: Dict[bytes, Dict[int, bytes]] = {}
+        self._shard_len: Dict[bytes, int] = {}
+        # root -> set of READY senders (rbc/request.go ReadyReqRepository)
+        self._ready_senders: Dict[bytes, Set[str]] = {}
+        self._bad_roots: Set[bytes] = set()  # failed interpolation recheck
+        self._decoded: Dict[bytes, bytes] = {}  # successful decode cache
+        self._value: Optional[bytes] = None
+
+    # -- public API (reference rbc/rbc.go:38-76) ---------------------------
+
+    def value(self) -> Optional[bytes]:
+        """The delivered value, or None (reference rbc/rbc.go:69-71)."""
+        return self._value
+
+    @property
+    def delivered(self) -> bool:
+        return self._value is not None
+
+    def propose(self, value: bytes) -> None:
+        """Shard, build the Merkle tree, send VAL_j to each node j
+        (reference rbc/rbc.go:42-44 `broadcast` + :98-100 `shard`)."""
+        if self.owner != self.proposer:
+            raise ValueError(
+                f"{self.owner!r} cannot propose in {self.proposer!r}'s RBC"
+            )
+        if len(value) > self.k * MAX_SHARD_BYTES - 4 - self.k * 128:
+            # shards receivers would reject in _check_proof: fail fast
+            raise ValueError(
+                f"value of {len(value)} bytes exceeds the "
+                f"{self.k} x {MAX_SHARD_BYTES}-byte shard capacity"
+            )
+        data = split_payload(value, self.k)
+        shards = self.crypto.erasure.encode(data)  # (n, L)
+        tree = self.crypto.merkle.build(shards)
+        root = tree.root
+        for j, member in enumerate(self.members):
+            payload = RbcPayload(
+                type=RbcType.VAL,
+                proposer=self.proposer,
+                epoch=self.epoch,
+                root_hash=root,
+                branch=tuple(tree.branch(j)),
+                shard=shards[j].tobytes(),
+                shard_index=j,
+            )
+            self.out.send_to(member, payload)
+
+    def handle_message(self, sender: str, payload: RbcPayload) -> None:
+        """Public entry (reference rbc/rbc.go:46-54)."""
+        if not isinstance(payload, RbcPayload):
+            return
+        if self.delivered or sender not in self._member_set:
+            return
+        if payload.type == RbcType.VAL:
+            self._handle_val(sender, payload)
+        elif payload.type == RbcType.ECHO:
+            self._handle_echo(sender, payload)
+        elif payload.type == RbcType.READY:
+            self._handle_ready(sender, payload)
+
+    # -- handlers ----------------------------------------------------------
+
+    def _check_proof(self, payload: RbcPayload) -> bool:
+        """Branch verification (reference rbc/rbc.go:93-95
+        `validateMessage`, docs/RBC-EN.md:35)."""
+        if not (0 <= payload.shard_index < self.n):
+            return False
+        if not (0 < len(payload.shard) <= MAX_SHARD_BYTES):
+            return False
+        if len(payload.root_hash) != 32:
+            return False
+        # depth of the padded tree the proposer must have built
+        p = 1
+        depth = 0
+        while p < self.n:
+            p <<= 1
+            depth += 1
+        if len(payload.branch) != depth:
+            return False
+        if any(len(b) != 32 for b in payload.branch):
+            return False
+        # shards of one root must agree on length (RS needs a matrix)
+        want_len = self._shard_len.get(payload.root_hash)
+        if want_len is not None and len(payload.shard) != want_len:
+            return False
+        return self.crypto.merkle.verify_branch(
+            payload.root_hash,
+            payload.shard,
+            list(payload.branch),
+            payload.shard_index,
+        )
+
+    def _handle_val(self, sender: str, payload: RbcPayload) -> None:
+        """docs/RBC-EN.md:34 — echo the received (h, b(j), s(j)) to all.
+
+        Only the proposer may send VAL, and only the first one counts
+        (reference rbc/rbc.go:56-58)."""
+        if sender != self.proposer or self._echo_sent:
+            return
+        if not self._check_proof(payload):
+            return
+        self._echo_sent = True
+        self.out.broadcast(
+            RbcPayload(
+                type=RbcType.ECHO,
+                proposer=self.proposer,
+                epoch=self.epoch,
+                root_hash=payload.root_hash,
+                branch=payload.branch,
+                shard=payload.shard,
+                shard_index=payload.shard_index,
+            )
+        )
+
+    def _handle_echo(self, sender: str, payload: RbcPayload) -> None:
+        """docs/RBC-EN.md:35-39 (reference rbc/rbc.go:60-62)."""
+        root = payload.root_hash
+        if sender in self._echo_voted:  # one ECHO per sender
+            return
+        if not self._check_proof(payload):
+            return
+        self._echo_voted.add(sender)
+        senders = self._echo_senders.setdefault(root, set())
+        senders.add(sender)
+        self._shard_len.setdefault(root, len(payload.shard))
+        self._shards.setdefault(root, {})[payload.shard_index] = payload.shard
+        # N-f valid ECHOs -> interpolate, recheck root, READY
+        if (
+            len(senders) >= self.n - self.f
+            and self._ready_root is None
+            and root not in self._bad_roots
+        ):
+            if self._decode(root) is not None:
+                self._send_ready(root)
+        self._maybe_deliver(root)
+
+    def _handle_ready(self, sender: str, payload: RbcPayload) -> None:
+        """docs/RBC-EN.md:41-42 (reference rbc/rbc.go:64-66)."""
+        root = payload.root_hash
+        if len(root) != 32:
+            return
+        if sender in self._ready_voted:  # one READY per sender
+            return
+        self._ready_voted.add(sender)
+        senders = self._ready_senders.setdefault(root, set())
+        senders.add(sender)
+        # f+1 READY(h) -> relay READY(h) once (amplification step)
+        if len(senders) >= self.f + 1 and self._ready_root is None:
+            self._send_ready(root)
+        self._maybe_deliver(root)
+
+    # -- quorum actions ----------------------------------------------------
+
+    def _send_ready(self, root: bytes) -> None:
+        self._ready_root = root
+        self.out.broadcast(
+            RbcPayload(
+                type=RbcType.READY,
+                proposer=self.proposer,
+                epoch=self.epoch,
+                root_hash=root,
+            )
+        )
+
+    def _decode(self, root: bytes) -> Optional[bytes]:
+        """Interpolate K shards, re-encode, recompute the Merkle root
+        (the Byzantine-proposer check of docs/RBC-EN.md:37-39;
+        reference rbc/rbc.go:88-90's '< N-2f shards -> error').
+
+        Returns the decoded value or None (insufficient / bad root).
+        """
+        if root in self._decoded:
+            return self._decoded[root]
+        if root in self._bad_roots:
+            return None
+        shards = self._shards.get(root, {})
+        if len(shards) < self.k:
+            return None
+        idxs = sorted(shards)[: self.k]
+        mat = np.stack(
+            [np.frombuffer(shards[i], dtype=np.uint8) for i in idxs]
+        )
+        data = self.crypto.erasure.decode(idxs, mat)
+        full = self.crypto.erasure.encode(data)
+        tree = self.crypto.merkle.build(full)
+        if tree.root != root:
+            self._bad_roots.add(root)
+            return None
+        try:
+            value = join_payload(data)
+        except ValueError:  # corrupt length framing from the proposer
+            self._bad_roots.add(root)
+            return None
+        self._decoded[root] = value
+        return value
+
+    def _maybe_deliver(self, root: bytes) -> None:
+        """2f+1 READY(h) + N-2f verified shards -> deliver
+        (docs/RBC-EN.md:41-42)."""
+        if self.delivered:
+            return
+        if len(self._ready_senders.get(root, ())) < 2 * self.f + 1:
+            return
+        value = self._decode(root)
+        if value is None:
+            return
+        self._value = value
+        # free per-root buffers; the instance is terminal now
+        self._shards.clear()
+        self._echo_senders.clear()
+        if self.on_deliver is not None:
+            self.on_deliver(self.proposer, value)
+
+
+__all__ = ["RBC", "MAX_SHARD_BYTES"]

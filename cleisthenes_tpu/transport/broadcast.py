@@ -1,0 +1,56 @@
+"""Payload-level broadcast seam between protocol instances and transport.
+
+The reference's protocol structs hold a ``cleisthenes.Broadcaster``
+(reference rbc/rbc.go:35, bba/bba.go:60) and never touch gRPC directly;
+this module is that seam for payloads: the protocol layer emits typed
+payloads, the broadcaster wraps them in the authenticated envelope and
+hands them to a concrete transport.
+
+``broadcast`` includes the sending node itself: HBBFT quorum counting
+treats the local node as a normal peer (its own ECHO/READY/BVAL votes
+count), and routing self-delivery through the same transport keeps the
+deterministic scheduler in charge of *all* message interleavings.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Protocol, Sequence, runtime_checkable
+
+from cleisthenes_tpu.transport.message import Message, Payload
+
+
+@runtime_checkable
+class PayloadBroadcaster(Protocol):
+    def broadcast(self, payload: Payload) -> None: ...
+
+    def send_to(self, member_id: str, payload: Payload) -> None: ...
+
+
+class ChannelBroadcaster:
+    """PayloadBroadcaster over an in-proc ChannelNetwork.
+
+    Envelope signing happens inside the network at post time (each
+    endpoint's Authenticator), mirroring the reference where the conn
+    layer owns signatures (conn.go:134-137's intent)."""
+
+    def __init__(self, network, node_id: str, member_ids: Sequence[str]):
+        self._network = network
+        self._node_id = node_id
+        self._members: List[str] = sorted(member_ids)
+
+    def _wrap(self, payload: Payload) -> Message:
+        return Message(
+            sender_id=self._node_id, timestamp=time.time(), payload=payload
+        )
+
+    def broadcast(self, payload: Payload) -> None:
+        msg = self._wrap(payload)
+        for member in self._members:
+            self._network.post(self._node_id, member, msg)
+
+    def send_to(self, member_id: str, payload: Payload) -> None:
+        self._network.post(self._node_id, member_id, self._wrap(payload))
+
+
+__all__ = ["PayloadBroadcaster", "ChannelBroadcaster"]
