@@ -224,6 +224,60 @@ def verify_shares(
     return out
 
 
+class SharePool:
+    """Sender-keyed pool of DhShares with batched verification.
+
+    One slot per roster sender (an honest node submits exactly one
+    share per context), so a Byzantine peer can only ever occupy — and
+    then burn — its own slot: a sender whose share fails verification
+    is remembered in ``_burned`` and can never resubmit, bounding both
+    memory and re-verification work.  Valid shares are deduped by
+    Shamir index before combination (a Byzantine sender may replay
+    another node's valid share, which must not trip the distinct-
+    index requirement of Lagrange interpolation).
+
+    Shared by the BBA common coin and the TPKE decryption path — the
+    two consumers of threshold shares in HBBFT.
+    """
+
+    def __init__(self, threshold: int):
+        self.threshold = threshold
+        self._shares: Dict[str, DhShare] = {}
+        self._burned: set = set()
+
+    def add(self, sender: str, share: DhShare) -> bool:
+        """First share per non-burned sender wins."""
+        if sender in self._shares or sender in self._burned:
+            return False
+        self._shares[sender] = share
+        return True
+
+    def __len__(self) -> int:
+        return len(self._shares)
+
+    def try_verified(self, verify_fn) -> Optional[List[DhShare]]:
+        """If >= threshold shares are pooled, batch-verify them all
+        (``verify_fn(shares) -> List[bool]``, ONE TPU dispatch under
+        the 'tpu' backend), burn the senders of invalid ones, and
+        return >= threshold index-distinct valid shares — or None if
+        not there yet."""
+        if len(self._shares) < self.threshold:
+            return None
+        senders = list(self._shares)
+        shares = [self._shares[s] for s in senders]
+        ok = verify_fn(shares)
+        by_index: Dict[int, DhShare] = {}
+        for sender, share, good in zip(senders, shares, ok):
+            if good:
+                by_index.setdefault(share.index, share)
+            else:
+                del self._shares[sender]
+                self._burned.add(sender)
+        if len(by_index) < self.threshold:
+            return None
+        return list(by_index.values())
+
+
 def combine_shares(
     shares: Sequence[DhShare], threshold: int
 ) -> int:
@@ -323,6 +377,7 @@ __all__ = [
     "ThresholdPublicKey",
     "ThresholdSecretShare",
     "DhShare",
+    "SharePool",
     "Ciphertext",
     "deal",
     "issue_share",

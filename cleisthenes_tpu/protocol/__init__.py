@@ -8,6 +8,13 @@ the batched ops plane (cleisthenes_tpu.ops) through the BatchCrypto
 seam.
 """
 
+from cleisthenes_tpu.protocol.acs import ACS
+from cleisthenes_tpu.protocol.bba import BBA
+from cleisthenes_tpu.protocol.honeybadger import (
+    HoneyBadger,
+    NodeKeys,
+    setup_keys,
+)
 from cleisthenes_tpu.protocol.rbc import RBC
 
-__all__ = ["RBC"]
+__all__ = ["RBC", "BBA", "ACS", "HoneyBadger", "NodeKeys", "setup_keys"]

@@ -1,0 +1,150 @@
+"""ACS: asynchronous common subset = N x RBC + N x BBA.
+
+The component the reference names as required but never started
+("TODO : HoneyBadger must have ACS", reference honeybadger.go:19;
+composition depicted in img/acs.png and described at
+docs/HONEYBADGER-EN.md:85-89):
+
+  - input v        -> RBC_self.propose(v)
+  - RBC_j delivers -> input 1 to BBA_j (if BBA_j has no input yet)
+  - n-f BBAs output 1 -> input 0 to every BBA without input
+  - all N BBAs decided -> wait for RBC_j delivery for every j with
+    BBA_j = 1 (guaranteed by RBC totality: some correct node delivered
+    RBC_j, or no correct node would have voted 1) -> output the union
+    {j: value_j} for BBA_j = 1
+
+Properties (docs/HONEYBADGER-EN.md:34-37): Validity (output contains
+the inputs of >= n-2f correct nodes), Agreement (all correct nodes
+output the same set), Totality (all correct nodes eventually output).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Set
+
+from cleisthenes_tpu.config import Config
+from cleisthenes_tpu.ops.backend import BatchCrypto
+from cleisthenes_tpu.ops.coin import CommonCoin
+from cleisthenes_tpu.ops.tpke import ThresholdSecretShare
+from cleisthenes_tpu.protocol.bba import BBA
+from cleisthenes_tpu.protocol.rbc import RBC
+from cleisthenes_tpu.transport.message import (
+    BbaPayload,
+    CoinPayload,
+    RbcPayload,
+)
+
+
+class ACS:
+    """One common-subset instance (one per epoch)."""
+
+    def __init__(
+        self,
+        *,
+        config: Config,
+        crypto: BatchCrypto,
+        epoch: int,
+        owner: str,
+        member_ids: Sequence[str],
+        coin: CommonCoin,
+        coin_secret: ThresholdSecretShare,
+        out,
+    ) -> None:
+        self.n = config.n
+        self.f = config.f
+        self.epoch = epoch
+        self.owner = owner
+        self.members: List[str] = sorted(member_ids)
+        # fn(epoch, {proposer: value}) fired exactly once
+        self.on_output: Optional[Callable[[int, Dict[str, bytes]], None]] = None
+
+        self.rbcs: Dict[str, RBC] = {}
+        self.bbas: Dict[str, BBA] = {}
+        for proposer in self.members:
+            rbc = RBC(
+                config=config,
+                crypto=crypto,
+                epoch=epoch,
+                proposer=proposer,
+                owner=owner,
+                member_ids=self.members,
+                out=out,
+            )
+            rbc.on_deliver = self._on_rbc_deliver
+            self.rbcs[proposer] = rbc
+            bba = BBA(
+                config=config,
+                epoch=epoch,
+                proposer=proposer,
+                owner=owner,
+                member_ids=self.members,
+                coin=coin,
+                coin_secret=coin_secret,
+                out=out,
+            )
+            bba.on_decide = self._on_bba_decide
+            self.bbas[proposer] = bba
+
+        self._input_given: Set[str] = set()  # BBAs we provided input to
+        self._zero_phase = False  # n-f ones seen, 0s injected
+        self._output: Optional[Dict[str, bytes]] = None
+
+    # -- public API --------------------------------------------------------
+
+    def input(self, value: bytes) -> None:
+        """Propose this node's value (the HoneyBadger TPKE ciphertext,
+        docs/HONEYBADGER-EN.md:58-61)."""
+        self.rbcs[self.owner].propose(value)
+
+    def output(self) -> Optional[Dict[str, bytes]]:
+        return self._output
+
+    @property
+    def done(self) -> bool:
+        return self._output is not None
+
+    def handle_message(self, sender: str, payload) -> None:
+        """Route by payload kind + instance (proposer)."""
+        proposer = getattr(payload, "proposer", None)
+        if proposer not in self.rbcs:
+            return
+        if isinstance(payload, RbcPayload):
+            self.rbcs[proposer].handle_message(sender, payload)
+        elif isinstance(payload, (BbaPayload, CoinPayload)):
+            self.bbas[proposer].handle_message(sender, payload)
+
+    # -- composition rules (img/acs.png) -----------------------------------
+
+    def _on_rbc_deliver(self, proposer: str, value: bytes) -> None:
+        # deliver_j -> BBA_j(1), unless we already voted (possibly 0)
+        if proposer not in self._input_given:
+            self._input_given.add(proposer)
+            self.bbas[proposer].input(True)
+        self._maybe_output()
+
+    def _on_bba_decide(self, proposer: str, decision: bool) -> None:
+        ones = sum(1 for b in self.bbas.values() if b.result() is True)
+        if ones >= self.n - self.f and not self._zero_phase:
+            # n-f BBAs delivered 1: vote 0 on everything still open
+            self._zero_phase = True
+            for p in self.members:
+                if p not in self._input_given:
+                    self._input_given.add(p)
+                    self.bbas[p].input(False)
+        self._maybe_output()
+
+    def _maybe_output(self) -> None:
+        if self._output is not None:
+            return
+        if any(not b.done for b in self.bbas.values()):
+            return
+        accepted = [p for p in self.members if self.bbas[p].result() is True]
+        # totality: every 1-decided RBC will deliver; wait for them
+        if any(not self.rbcs[p].delivered for p in accepted):
+            return
+        self._output = {p: self.rbcs[p].value() for p in accepted}
+        if self.on_output is not None:
+            self.on_output(self.epoch, dict(self._output))
+
+
+__all__ = ["ACS"]

@@ -1,0 +1,374 @@
+"""BBA: randomized binary Byzantine agreement with a threshold coin.
+
+Completes the reference's skeleton (reference bba/bba.go:63-107,
+bba/binary_set.go:7-11) per its own spec (reference docs/BBA-EN.md):
+
+  round r, estimate est:
+    broadcast BVAL(est)                              (docs/BBA-EN.md:39-44)
+    on f+1  BVAL(v): relay BVAL(v) once              (docs/BBA-EN.md:47-52)
+    on 2f+1 BVAL(v): bin_values U= {v}               (docs/BBA-EN.md:53-58,
+                                                      bba/binary_set.go union)
+    when bin_values first non-empty: broadcast AUX(w), w in bin_values
+                                                     (docs/BBA-EN.md:134-139)
+    await n-f AUX whose values are in bin_values -> vals
+                                                     (docs/BBA-EN.md:140-156)
+    s = common_coin(r)                               (docs/BBA-EN.md:163-177)
+    vals == {b}: est = b; decide b if b == s
+    else:        est = s; next round
+
+The common coin is the threshold VUF of ops.coin: each node broadcasts
+one share per (instance, round); f+1 verified shares combine to the
+network-global bit.  Share verification is batched through the
+BatchCrypto seam (one TPU dispatch per reveal under 'tpu').
+
+Termination (the part docs/BBA-EN.md leaves open): deciding alone must
+not stop a node — rounds need n-f live participants, so a decided node
+keeps participating with its estimate pinned to the decision, and a
+Bracha-style TERM gadget provides the actual exit: broadcast TERM(b)
+on decision; adopt-decide on f+1 TERM(b); halt on 2f+1 TERM(b)
+(>= f+1 of those are correct, so every correct node eventually adopts
+and halts too).
+
+The epoch/round bookkeeping mirrors the reference struct
+(bba/bba.go:27-61): n, f, proposer, epoch + internal round,
+sentBvalSet, est/dec binaries, per-type repos, and the future-message
+buffer (bba/request.go:28-32 semantics, here applied to rounds within
+the instance; epochs are buffered one level up by HoneyBadger).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from cleisthenes_tpu.config import Config
+from cleisthenes_tpu.ops.coin import CommonCoin
+from cleisthenes_tpu.ops.tpke import (
+    DhShare,
+    SharePool,
+    ThresholdSecretShare,
+)
+from cleisthenes_tpu.transport.message import (
+    BbaPayload,
+    BbaType,
+    CoinPayload,
+)
+
+# A Byzantine peer must not park unbounded state for distant rounds.
+ROUND_HORIZON = 8
+MAX_BUFFERED_PER_SENDER = 4 * ROUND_HORIZON
+# Probabilistic termination: P(not done) halves per round; 1000 rounds
+# is unreachable in practice and bounds state against pathology.
+MAX_ROUNDS = 1000
+
+
+class _Round:
+    """Per-round state (the reference keeps one flat set because it
+    never finished multi-round flow; bba/bba.go:44-51)."""
+
+    __slots__ = (
+        "bval_sent",
+        "bval_recv",
+        "bin_values",
+        "aux_sent",
+        "aux_recv",
+        "coin_share_sent",
+        "coin_shares",
+        "coin_value",
+        "advanced",
+    )
+
+    def __init__(self, coin_threshold: int) -> None:
+        self.bval_sent: Set[bool] = set()
+        self.bval_recv: Dict[bool, Set[str]] = {True: set(), False: set()}
+        self.bin_values: Set[bool] = set()  # bba/binary_set.go:3-5
+        self.aux_sent: Optional[bool] = None
+        self.aux_recv: Dict[str, bool] = {}
+        self.coin_share_sent = False
+        # sender-keyed with burned-slot tracking: a Byzantine peer can
+        # only ever occupy (and burn) its own slot, never censor an
+        # honest node's share or force repeated re-verification
+        self.coin_shares = SharePool(coin_threshold)
+        self.coin_value: Optional[bool] = None
+        self.advanced = False
+
+
+class BBA:
+    """One binary-agreement instance: (epoch, proposer)."""
+
+    def __init__(
+        self,
+        *,
+        config: Config,
+        epoch: int,
+        proposer: str,
+        owner: str,
+        member_ids,
+        coin: CommonCoin,
+        coin_secret: ThresholdSecretShare,
+        out,
+    ) -> None:
+        self.n = config.n
+        self.f = config.f
+        self.epoch = epoch
+        self.proposer = proposer
+        self.owner = owner
+        self.members = sorted(member_ids)
+        self._member_set = frozenset(self.members)
+        self.coin = coin
+        self.coin_secret = coin_secret
+        self.out = out
+
+        self.round = 0
+        self.est: Optional[bool] = None
+        self.decided: Optional[bool] = None  # dec (bba/bba.go:50)
+        self.halted = False
+        self.on_decide: Optional[Callable[[str, bool], None]] = None
+
+        self._rounds: Dict[int, _Round] = {0: _Round(coin.pub.threshold)}
+        self._term_sent = False
+        self._term_recv: Dict[bool, Set[str]] = {True: set(), False: set()}
+        self._term_voted: Set[str] = set()
+        # (round -> [(sender, payload)]) future-round parking
+        self._future: Dict[int, List[Tuple[str, object]]] = {}
+        self._buffered_per_sender: Dict[str, int] = {}
+
+    # -- public API (reference bba/bba.go:63-87) ---------------------------
+
+    def result(self) -> Optional[bool]:
+        """Reference bba/bba.go:78-80."""
+        return self.decided
+
+    @property
+    def done(self) -> bool:
+        return self.decided is not None
+
+    def input(self, est: bool) -> None:
+        """Reference bba/bba.go:69-71 HandleInput: set the initial
+        estimate and open round 0.  Ignored if the instance already
+        derived an estimate (it advanced rounds passively before the
+        caller got around to providing input — ACS inputs 0 late)."""
+        if self.halted or self.est is not None:
+            return
+        self.est = bool(est)
+        self._broadcast_bval(self.round, self.est)
+
+    def handle_message(self, sender: str, payload) -> None:
+        """Reference bba/bba.go:74-76 HandleMessage + :89-99 muxRequest."""
+        if self.halted or sender not in self._member_set:
+            return
+        if isinstance(payload, BbaPayload):
+            if payload.type == BbaType.TERM:
+                self._handle_term(sender, payload.value)
+                return
+            self._gated(sender, payload, payload.round)
+        elif isinstance(payload, CoinPayload):
+            self._gated(sender, payload, payload.round)
+
+    # -- round gating ------------------------------------------------------
+
+    def _gated(self, sender: str, payload, rnd: int) -> None:
+        """Process current-round messages; park future rounds within
+        the horizon (bba/request.go:28-32 pattern, per-round)."""
+        if rnd < self.round or rnd >= MAX_ROUNDS:
+            return  # stale: quorums it could join are already closed
+        if rnd > self.round:
+            if rnd > self.round + ROUND_HORIZON:
+                return
+            count = self._buffered_per_sender.get(sender, 0)
+            if count >= MAX_BUFFERED_PER_SENDER:
+                return
+            self._buffered_per_sender[sender] = count + 1
+            self._future.setdefault(rnd, []).append((sender, payload))
+            return
+        self._dispatch(sender, payload)
+
+    def _dispatch(self, sender: str, payload) -> None:
+        if isinstance(payload, BbaPayload):
+            if payload.type == BbaType.BVAL:
+                self._handle_bval(sender, payload.value)
+            elif payload.type == BbaType.AUX:
+                self._handle_aux(sender, payload.value)
+        elif isinstance(payload, CoinPayload):
+            self._handle_coin_share(sender, payload)
+
+    # -- BVAL / AUX (reference bba/bba.go:101-107, empty in skeleton) ------
+
+    def _cur(self) -> _Round:
+        return self._rounds[self.round]
+
+    def _broadcast_bval(self, rnd: int, value: bool) -> None:
+        r = self._rounds[rnd]
+        if value in r.bval_sent:
+            return
+        r.bval_sent.add(value)
+        self.out.broadcast(
+            BbaPayload(
+                type=BbaType.BVAL,
+                proposer=self.proposer,
+                epoch=self.epoch,
+                round=rnd,
+                value=value,
+            )
+        )
+
+    def _handle_bval(self, sender: str, value: bool) -> None:
+        r = self._cur()
+        recv = r.bval_recv[value]
+        if sender in recv:
+            return
+        recv.add(sender)
+        # f+1 same bval -> relay once (docs/BBA-EN.md:47-52; the
+        # sentBvalSet of bba/bba.go:48)
+        if len(recv) >= self.f + 1:
+            self._broadcast_bval(self.round, value)
+        # 2f+1 -> bin_values union (docs/BBA-EN.md:53-58)
+        if len(recv) >= 2 * self.f + 1 and value not in r.bin_values:
+            r.bin_values.add(value)
+            if r.aux_sent is None:
+                r.aux_sent = value
+                self.out.broadcast(
+                    BbaPayload(
+                        type=BbaType.AUX,
+                        proposer=self.proposer,
+                        epoch=self.epoch,
+                        round=self.round,
+                        value=value,
+                    )
+                )
+            # bin_values growth can complete the AUX quorum
+            self._maybe_request_coin()
+            self._maybe_advance()
+
+    def _handle_aux(self, sender: str, value: bool) -> None:
+        r = self._cur()
+        if sender in r.aux_recv:
+            return
+        r.aux_recv[sender] = value
+        self._maybe_request_coin()
+        self._maybe_advance()
+
+    def _aux_quorum(self) -> bool:
+        """n-f AUX messages whose values are in bin_values
+        (docs/BBA-EN.md:140-156)."""
+        r = self._cur()
+        good = sum(1 for v in r.aux_recv.values() if v in r.bin_values)
+        return good >= self.n - self.f
+
+    # -- common coin (docs/BBA-EN.md:163-181) ------------------------------
+
+    def _coin_id(self, rnd: int) -> bytes:
+        return b"%d|%s|%d" % (self.epoch, self.proposer.encode(), rnd)
+
+    def _maybe_request_coin(self) -> None:
+        """First AUX quorum -> contribute our coin share for this round."""
+        r = self._cur()
+        if r.coin_share_sent or not self._aux_quorum():
+            return
+        r.coin_share_sent = True
+        share = self.coin.share(self.coin_secret, self._coin_id(self.round))
+        self.out.broadcast(
+            CoinPayload(
+                proposer=self.proposer,
+                epoch=self.epoch,
+                round=self.round,
+                index=share.index,
+                d=share.d,
+                e=share.e,
+                z=share.z,
+            )
+        )
+
+    def _handle_coin_share(self, sender: str, p: CoinPayload) -> None:
+        r = self._cur()
+        if r.coin_value is not None or not (1 <= p.index <= self.n):
+            return
+        if r.coin_shares.add(
+            sender, DhShare(index=p.index, d=p.d, e=p.e, z=p.z)
+        ):
+            self._maybe_reveal_coin()
+
+    def _maybe_reveal_coin(self) -> None:
+        r = self._cur()
+        if r.coin_value is not None:
+            return
+        coin_id = self._coin_id(self.round)
+        # batched CP verification — ONE TPU dispatch under 'tpu'
+        valid = r.coin_shares.try_verified(
+            lambda shares: self.coin.verify_shares(coin_id, shares)
+        )
+        if valid is None:
+            return
+        r.coin_value = self.coin.toss(coin_id, valid)
+        self._maybe_advance()
+
+    # -- round transition --------------------------------------------------
+
+    def _maybe_advance(self) -> None:
+        r = self._cur()
+        if r.advanced or r.coin_value is None or not self._aux_quorum():
+            return
+        vals = {
+            v for v in r.aux_recv.values() if v in r.bin_values
+        }  # docs/BBA-EN.md:140-156
+        coin = r.coin_value
+        r.advanced = True
+        if len(vals) == 1:
+            (b,) = vals
+            next_est = b
+            if b == coin and self.decided is None:
+                self._decide(b)
+        else:
+            next_est = coin
+        if self.decided is not None:
+            # decided nodes keep participating, estimate pinned, so
+            # laggards' rounds retain n-f live members
+            next_est = self.decided
+        self.round += 1
+        self.est = next_est
+        self._rounds[self.round] = _Round(self.coin.pub.threshold)
+        self._broadcast_bval(self.round, next_est)
+        # GC old round, replay parked messages for the new one
+        self._rounds.pop(self.round - 1, None)
+        for sender, payload in self._future.pop(self.round, []):
+            cnt = self._buffered_per_sender.get(sender, 0)
+            if cnt > 0:
+                self._buffered_per_sender[sender] = cnt - 1
+            if self.halted:
+                break
+            self._dispatch(sender, payload)
+
+    # -- decision & termination --------------------------------------------
+
+    def _decide(self, b: bool) -> None:
+        self.decided = b
+        if not self._term_sent:
+            self._term_sent = True
+            self.out.broadcast(
+                BbaPayload(
+                    type=BbaType.TERM,
+                    proposer=self.proposer,
+                    epoch=self.epoch,
+                    round=self.round,
+                    value=b,
+                )
+            )
+        if self.on_decide is not None:
+            self.on_decide(self.proposer, b)
+
+    def _handle_term(self, sender: str, value: bool) -> None:
+        if sender in self._term_voted:
+            return
+        self._term_voted.add(sender)
+        self._term_recv[value].add(sender)
+        n_votes = len(self._term_recv[value])
+        if n_votes >= self.f + 1 and self.decided is None:
+            self._decide(value)  # adopt: f+1 guarantees a correct voter
+        if n_votes >= 2 * self.f + 1:
+            # enough correct nodes have decided and broadcast TERM that
+            # every correct node will adopt+halt without our help
+            self.halted = True
+            self._rounds.clear()
+            self._future.clear()
+
+
+__all__ = ["BBA", "ROUND_HORIZON", "MAX_ROUNDS"]

@@ -47,10 +47,17 @@ class RbcType(enum.IntEnum):
 
 
 class BbaType(enum.IntEnum):
-    """Reference pb/message.proto:39-43 (BBA.Type)."""
+    """Reference pb/message.proto:39-43 (BBA.Type), extended with TERM.
+
+    TERM is the Bracha-style termination gadget the reference's spec
+    needs but never wires (docs/BBA-EN.md stops at the coin): a decided
+    node broadcasts TERM(b) once; f+1 TERM(b) lets an undecided node
+    adopt b; 2f+1 TERM(b) lets anyone halt the instance for good.
+    """
 
     BVAL = 0
     AUX = 1
+    TERM = 2
 
 
 @dataclasses.dataclass(frozen=True)

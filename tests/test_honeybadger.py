@@ -1,0 +1,221 @@
+"""End-to-end HoneyBadgerBFT: N in-proc validators over the channel
+transport committing identical batches (BASELINE config 1), plus the
+batch-policy unit tests mirroring the reference's
+honeybadger_internal_test.go:8-180."""
+
+import pytest
+
+from cleisthenes_tpu.config import Config
+from cleisthenes_tpu.protocol.honeybadger import (
+    HoneyBadger,
+    deserialize_ciphertext,
+    deserialize_txs,
+    serialize_ciphertext,
+    serialize_txs,
+    setup_keys,
+)
+from cleisthenes_tpu.transport.base import HmacAuthenticator
+from cleisthenes_tpu.transport.broadcast import ChannelBroadcaster
+from cleisthenes_tpu.transport.channel import ChannelNetwork
+
+
+def make_hb_network(
+    n, batch_size=16, seed=None, auth=True, auto_propose=True, key_seed=33
+):
+    cfg = Config(n=n, batch_size=batch_size)
+    ids = [f"node{i}" for i in range(n)]
+    keys = setup_keys(cfg, ids, seed=key_seed)
+    net = ChannelNetwork(seed=seed)
+    nodes = {}
+    for node_id in ids:
+        hb = HoneyBadger(
+            config=cfg,
+            node_id=node_id,
+            member_ids=ids,
+            keys=keys[node_id],
+            out=ChannelBroadcaster(net, node_id, ids),
+            auto_propose=auto_propose,
+        )
+        nodes[node_id] = hb
+        net.join(
+            node_id,
+            hb,
+            HmacAuthenticator(keys[node_id].mac_master, node_id)
+            if auth
+            else None,
+        )
+    return cfg, net, nodes
+
+
+def push_txs(nodes, count, prefix=b"tx"):
+    txs = []
+    for i in range(count):
+        tx = b"%s-%06d" % (prefix, i)
+        txs.append(tx)
+        # spray txs round-robin across nodes (each node's queue differs)
+        node = list(nodes.values())[i % len(nodes)]
+        node.add_transaction(tx)
+    return txs
+
+
+def assert_identical_batches(nodes, skip=()):
+    live = {nid: hb for nid, hb in nodes.items() if nid not in skip}
+    counts = {nid: len(hb.committed_batches) for nid, hb in live.items()}
+    depth = min(counts.values())
+    assert depth > 0, f"no common committed epoch: {counts}"
+    for e in range(depth):
+        lists = {
+            nid: hb.committed_batches[e].tx_list() for nid, hb in live.items()
+        }
+        first = next(iter(lists.values()))
+        for nid, txl in lists.items():
+            assert txl == first, f"epoch {e}: {nid} batch differs"
+    return depth
+
+
+# ---------------------------------------------------------------------------
+# serialization round-trips
+# ---------------------------------------------------------------------------
+
+
+def test_tx_list_roundtrip():
+    txs = [b"", b"a", b"hello" * 100, bytes(range(256))]
+    assert deserialize_txs(serialize_txs(txs)) == txs
+    assert deserialize_txs(serialize_txs([])) == []
+
+
+def test_tx_list_rejects_garbage():
+    with pytest.raises(ValueError):
+        deserialize_txs(b"\x00")
+    with pytest.raises(ValueError):
+        deserialize_txs(b"\xff\xff\xff\xff" + b"x" * 10)
+    with pytest.raises(ValueError):
+        deserialize_txs(serialize_txs([b"a"]) + b"junk")
+
+
+def test_ciphertext_roundtrip():
+    from cleisthenes_tpu.ops.tpke import Tpke, deal
+
+    pub, _ = deal(4, 2, seed=1)
+    ct = Tpke(pub).encrypt(b"secret batch")
+    ct2 = deserialize_ciphertext(serialize_ciphertext(ct))
+    assert ct2 == ct
+    with pytest.raises(ValueError):
+        deserialize_ciphertext(b"short")
+
+
+# ---------------------------------------------------------------------------
+# batch policy (reference honeybadger_internal_test.go)
+# ---------------------------------------------------------------------------
+
+
+def test_batch_policy_b_is_max_of_batchsize_and_n():
+    cfg, net, nodes = make_hb_network(4, batch_size=2)
+    assert next(iter(nodes.values())).b == 4  # max(2, 4)
+    cfg2, net2, nodes2 = make_hb_network(4, batch_size=100)
+    assert next(iter(nodes2.values())).b == 100
+
+
+def test_create_batch_samples_b_over_n_and_restores_rest():
+    cfg, net, nodes = make_hb_network(4, batch_size=8, auto_propose=False)
+    hb = nodes["node0"]
+    for i in range(20):
+        hb.add_transaction(b"tx-%02d" % i)
+    picked = hb._create_batch()
+    # b/n = 8/4 = 2 picked; the other 6 candidates restored
+    assert len(picked) == 2
+    assert len(hb.que) == 18
+    assert len(set(picked)) == len(picked)
+
+
+def test_create_batch_with_few_txs_takes_what_exists():
+    cfg, net, nodes = make_hb_network(4, batch_size=8, auto_propose=False)
+    hb = nodes["node0"]
+    hb.add_transaction(b"only-one")
+    assert hb._create_batch() == [b"only-one"]
+    assert len(hb.que) == 0
+
+
+# ---------------------------------------------------------------------------
+# end-to-end epochs (BASELINE config 1)
+# ---------------------------------------------------------------------------
+
+
+def test_hbbft_single_epoch_identical_batches_n4():
+    cfg, net, nodes = make_hb_network(4, batch_size=16)
+    txs = push_txs(nodes, 16)
+    for hb in nodes.values():
+        hb.start_epoch()
+    net.run()
+    depth = assert_identical_batches(nodes)
+    assert depth >= 1
+    committed = set(nodes["node0"].committed_batches[0].tx_list())
+    assert committed <= set(txs)
+    assert len(committed) > 0
+
+
+def test_hbbft_runs_multiple_epochs_until_queues_drain():
+    cfg, net, nodes = make_hb_network(4, batch_size=8)
+    txs = push_txs(nodes, 24)
+    for hb in nodes.values():
+        hb.start_epoch()
+    net.run()
+    depth = assert_identical_batches(nodes)
+    assert depth >= 2  # 24 txs / (b=8 per epoch best case) needs >= 3
+    all_committed = [
+        tx for b in nodes["node0"].committed_batches for tx in b.tx_list()
+    ]
+    assert len(all_committed) == len(set(all_committed))  # no replays
+    assert set(all_committed) <= set(txs)
+
+
+def test_hbbft_commits_all_txs_eventually():
+    cfg, net, nodes = make_hb_network(4, batch_size=16)
+    txs = push_txs(nodes, 30)
+    for _ in range(40):  # keep kicking epochs until all queues drain
+        for hb in nodes.values():
+            hb.start_epoch()
+        net.run()
+        if all(hb.pending_tx_count() == 0 for hb in nodes.values()):
+            break
+    assert all(hb.pending_tx_count() == 0 for hb in nodes.values())
+    assert_identical_batches(nodes)
+    all_committed = {
+        tx for b in nodes["node0"].committed_batches for tx in b.tx_list()
+    }
+    assert all_committed == set(txs)
+
+
+@pytest.mark.parametrize("seed", [3, 12, 77])
+def test_hbbft_adversarial_scheduling(seed):
+    cfg, net, nodes = make_hb_network(4, batch_size=8, seed=seed)
+    push_txs(nodes, 16)
+    for hb in nodes.values():
+        hb.start_epoch()
+    net.run()
+    assert_identical_batches(nodes)
+
+
+def test_hbbft_tolerates_f_crashed_nodes():
+    cfg, net, nodes = make_hb_network(4, batch_size=8, seed=5)
+    crashed = "node3"
+    net.crash(crashed)
+    txs = push_txs(nodes, 12)
+    for nid, hb in nodes.items():
+        if nid != crashed:
+            hb.start_epoch()
+    net.run()
+    depth = assert_identical_batches(nodes, skip=(crashed,))
+    assert depth >= 1
+
+
+def test_hbbft_epoch_progression_and_queue_decrease():
+    cfg, net, nodes = make_hb_network(4, batch_size=8)
+    push_txs(nodes, 8)
+    before = sum(hb.pending_tx_count() for hb in nodes.values())
+    for hb in nodes.values():
+        hb.start_epoch()
+    net.run()
+    after = sum(hb.pending_tx_count() for hb in nodes.values())
+    assert after < before
+    assert all(hb.epoch >= 1 for hb in nodes.values())
