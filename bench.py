@@ -1,0 +1,141 @@
+"""Per-epoch crypto-plane benchmark (the BASELINE.json metric).
+
+Measures the wall-clock p50 of ONE HBBFT epoch's worth of hot-path
+crypto at BASELINE config 3 scale — N=64, f=21, 10k-tx batch — on the
+TPU backend, against the same work on the pure-CPU reference backend
+(the stand-in for the reference's pure-Go path, which publishes no
+numbers of its own; BASELINE.md "published: {}").
+
+One epoch's crypto (docs/HONEYBADGER-EN.md:93-96 cost model):
+  - RS-encode every validator's proposal into N shards       [N encodes]
+  - build the Merkle forest over all N shard sets            [N trees]
+  - verify the N^2 ECHO-phase Merkle branches                [N^2 proofs]
+  - RS-decode N proposals from K surviving shards            [N decodes]
+  - verify N^2 threshold-decryption shares (N per ciphertext)[N^2 CP checks]
+
+Prints ONE JSON line:
+  {"metric": ..., "value": p50_ms, "unit": "ms", "vs_baseline": cpu/tpu}
+
+``vs_baseline`` > 1 means the TPU crypto plane beats the CPU reference
+path; the north-star target is the whole epoch under 1000 ms.
+"""
+
+import json
+import statistics
+import time
+
+import numpy as np
+
+N = 64
+F = 21
+K = N - 2 * F  # 22 data shards
+BATCH_TXS = 10_000
+TX_BYTES = 64
+ITERS = 5
+SHARE_VERIFY_CHUNK = 4096  # CP checks per dispatch (2 dual-pows each)
+
+
+def payload_bytes() -> int:
+    # each validator proposes B/N txs (docs/HONEYBADGER-EN.md:51-56)
+    return (BATCH_TXS // N) * TX_BYTES
+
+
+def epoch_crypto(backend: str, rng: np.random.Generator) -> float:
+    """One epoch's batched crypto plane; returns seconds."""
+    from cleisthenes_tpu.ops.backend import BatchCrypto
+    from cleisthenes_tpu.ops.payload import split_payload
+    from cleisthenes_tpu.ops import tpke as tpke_mod
+
+    crypto = BatchCrypto(backend, N, F, K)
+
+    # --- prepare inputs (not timed) ---
+    proposals = [
+        rng.integers(0, 256, size=payload_bytes(), dtype=np.uint8).tobytes()
+        for _ in range(N)
+    ]
+    data = np.stack([split_payload(p, K) for p in proposals])  # (N, K, L)
+
+    pub, secrets_ = tpke_mod.deal(N, F + 1, seed=123)
+    ct = tpke_mod.Tpke(pub).encrypt(b"epoch-key-material")
+    ctx = b"bench-ctx"
+    shares = [
+        tpke_mod.issue_share(secrets_[i % N], ct.c1, ctx) for i in range(N)
+    ]
+
+    t0 = time.perf_counter()
+
+    # RS encode all N proposals -> (N, n, L)
+    encoded = crypto.erasure.encode_batch(data)
+
+    # Merkle forest: one tree per proposal
+    trees = crypto.merkle.build_batch(encoded)
+
+    # ECHO-phase branch verification: N branches per instance = N^2
+    roots = np.stack(
+        [np.frombuffer(t.root, dtype=np.uint8) for t in trees]
+    ).repeat(N, axis=0)
+    leaves = encoded.reshape(N * N, -1)
+    depth = trees[0].depth
+    branches = np.stack(
+        [
+            np.stack([np.frombuffer(s, dtype=np.uint8) for s in t.branch(j)])
+            for t in trees
+            for j in range(N)
+        ]
+    ).reshape(N * N, depth, 32)
+    indices = np.tile(np.arange(N), N)
+    ok = crypto.merkle.verify_batch(roots, leaves, branches, indices)
+    assert bool(ok.all())
+
+    # RS decode: reconstruct each proposal from K surviving shards
+    # (the worst-case parity-heavy survivor set)
+    survivor_idx = np.arange(N - K, N)
+    dec = crypto.erasure.decode_batch(
+        np.tile(survivor_idx, (N, 1)),
+        encoded[:, survivor_idx, :],
+    )
+    assert dec.shape == data.shape
+
+    # TPKE share verification: N shares per ciphertext x N ciphertexts,
+    # batched through the ModEngine in fixed-size dispatches
+    all_shares = shares * N  # N^2 CP proofs
+    for off in range(0, len(all_shares), SHARE_VERIFY_CHUNK):
+        res = tpke_mod.verify_shares(
+            pub,
+            ct.c1,
+            all_shares[off : off + SHARE_VERIFY_CHUNK],
+            ctx,
+            backend=backend,
+        )
+        assert all(res)
+
+    return time.perf_counter() - t0
+
+
+def measure(backend: str) -> float:
+    rng = np.random.default_rng(7)
+    epoch_crypto(backend, rng)  # warm-up (jit compile)
+    times = [epoch_crypto(backend, rng) for _ in range(ITERS)]
+    return statistics.median(times)
+
+
+def main() -> None:
+    # the accelerated path under test ('tpu' = XLA on whatever device
+    # is present; on a CPU-only host it still exercises the XLA path)
+    accel_p50 = measure("tpu")
+    # the pure-CPU reference path (numpy GF tables + python modexp)
+    cpu_p50 = measure("cpu")
+    print(
+        json.dumps(
+            {
+                "metric": "epoch_crypto_p50_n64_f21_b10k",
+                "value": round(accel_p50 * 1000.0, 3),
+                "unit": "ms",
+                "vs_baseline": round(cpu_p50 / accel_p50, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

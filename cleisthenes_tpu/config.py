@@ -39,7 +39,10 @@ class Config:
         seam from BASELINE.json.
       dial_timeout_s: client dial timeout (reference comm.go:107-109).
       channel_capacity: per-connection mailbox depth (conn.go:60-61).
-      seed: deterministic seed for batch sampling / test schedulers.
+      seed: None (default) draws batch-sampling randomness from the OS
+        CSPRNG — production mode, keeping proposal selection
+        unpredictable (part of HBBFT's censorship-resistance story).
+        An int makes sampling deterministic, for tests/benchmarks only.
       coin_seed: shared setup seed for the threshold common-coin and
         TPKE key generation in trusted-dealer mode.
       mesh_shape: optional device-mesh layout (validators, shardlen)
@@ -53,7 +56,7 @@ class Config:
     crypto_backend: str = "cpu"
     dial_timeout_s: float = DEFAULT_DIAL_TIMEOUT_S
     channel_capacity: int = DEFAULT_CHANNEL_CAPACITY
-    seed: int = 0
+    seed: Optional[int] = None
     coin_seed: int = 1
     mesh_shape: Optional[tuple] = None
 

@@ -178,13 +178,21 @@ class CpuMerkle(MerkleBackend):
 
 
 class XlaMerkle(MerkleBackend):
-    """Batched SHA-256 on TPU (sha256_xla.sha256_batch).
+    """Batched SHA-256 Merkle forest on TPU.
 
-    The batch axis is padded to the next power of two (min 8) so the
-    jitted kernel compiles once per (bucket, length) instead of once
-    per exact batch size — tree building halves the batch every level
-    and would otherwise retrace each one.
+    ``build_batch`` and ``verify_batch`` are overridden with fully
+    device-resident jitted kernels: every tree level's hashing is part
+    of ONE XLA program (the base class would round-trip host<->device
+    per level).  The batch axis is padded to the next power of two
+    (min 8) so each (bucket, length) pair compiles exactly once.
     """
+
+    @staticmethod
+    def _bucket(b: int) -> int:
+        bucket = 8
+        while bucket < b:
+            bucket <<= 1
+        return bucket
 
     def _hash_batch(self, msgs: np.ndarray) -> np.ndarray:
         import jax.numpy as jnp
@@ -192,14 +200,57 @@ class XlaMerkle(MerkleBackend):
         from cleisthenes_tpu.ops.sha256_xla import sha256_batch
 
         b = msgs.shape[0]
-        bucket = 8
-        while bucket < b:
-            bucket <<= 1
+        bucket = self._bucket(b)
         if bucket != b:
             msgs = np.concatenate(
                 [msgs, np.zeros((bucket - b, msgs.shape[1]), dtype=np.uint8)]
             )
         return np.asarray(sha256_batch(jnp.asarray(msgs)))[:b]
+
+    def build_batch(self, shards: np.ndarray) -> List[MerkleTree]:
+        import jax.numpy as jnp
+
+        from cleisthenes_tpu.ops.sha256_xla import build_forest
+
+        b, n, _ = shards.shape
+        bucket = self._bucket(b)
+        if bucket != b:
+            shards = np.concatenate(
+                [shards, np.zeros((bucket - b,) + shards.shape[1:], np.uint8)]
+            )
+        levels = [np.asarray(lvl) for lvl in build_forest(jnp.asarray(shards))]
+        return [
+            MerkleTree([lvl[i] for lvl in levels], n_leaves=n)
+            for i in range(b)
+        ]
+
+    def verify_batch(
+        self,
+        roots: np.ndarray,
+        leaves: np.ndarray,
+        branches: np.ndarray,
+        indices: np.ndarray,
+    ) -> np.ndarray:
+        import jax.numpy as jnp
+
+        from cleisthenes_tpu.ops.sha256_xla import verify_branches
+
+        b = leaves.shape[0]
+        bucket = self._bucket(b)
+
+        def pad(a):
+            if bucket == b:
+                return a
+            reps = np.repeat(a[:1], bucket - b, axis=0)
+            return np.concatenate([a, reps])
+
+        ok = verify_branches(
+            jnp.asarray(pad(np.ascontiguousarray(roots, dtype=np.uint8))),
+            jnp.asarray(pad(np.ascontiguousarray(leaves, dtype=np.uint8))),
+            jnp.asarray(pad(np.ascontiguousarray(branches, dtype=np.uint8))),
+            jnp.asarray(pad(np.asarray(indices, dtype=np.uint32))),
+        )
+        return np.asarray(ok)[:b]
 
 
 def make_merkle(backend: str) -> MerkleBackend:

@@ -145,4 +145,92 @@ def _zero_digest() -> bytes:
     return hashlib.sha256(b"cleisthenes-tpu:empty-leaf").digest()
 
 
-__all__ = ["sha256_batch"]
+# ---------------------------------------------------------------------------
+# Device-resident Merkle kernels (consumed by ops.merkle.XlaMerkle)
+# ---------------------------------------------------------------------------
+
+_LEAF_PREFIX_BYTE = 0x00
+_NODE_PREFIX_BYTE = 0x01
+
+
+@jax.jit
+def build_forest(shards: jnp.ndarray):
+    """Build B Merkle trees in ONE XLA program.
+
+    shards (B, n, L) uint8 -> tuple of levels, levels[0] = (B, p, 32)
+    padded leaf digests up to levels[-1] = (B, 1, 32) roots, with
+    p = next power of two >= n.  Leaf digest = SHA256(0x00 || shard),
+    node = SHA256(0x01 || left || right) (ops.merkle convention).
+    """
+    b, n, l = shards.shape
+    leaf_msgs = jnp.concatenate(
+        [
+            jnp.full((b * n, 1), _LEAF_PREFIX_BYTE, dtype=jnp.uint8),
+            shards.reshape(b * n, l),
+        ],
+        axis=1,
+    )
+    cur = sha256_batch(leaf_msgs).reshape(b, n, 32)
+    p = 1
+    while p < n:
+        p <<= 1
+    if p != n:
+        pad = jnp.broadcast_to(
+            jnp.asarray(
+                np.frombuffer(_zero_digest(), dtype=np.uint8)
+            ),
+            (b, p - n, 32),
+        )
+        cur = jnp.concatenate([cur, pad], axis=1)
+    levels = [cur]
+    width = p
+    while width > 1:
+        half = width // 2
+        msgs = jnp.concatenate(
+            [
+                jnp.full((b * half, 1), _NODE_PREFIX_BYTE, dtype=jnp.uint8),
+                cur.reshape(b * half, 64),
+            ],
+            axis=1,
+        )
+        cur = sha256_batch(msgs).reshape(b, half, 32)
+        levels.append(cur)
+        width = half
+    return tuple(levels)
+
+
+@jax.jit
+def verify_branches(
+    roots: jnp.ndarray,
+    leaves: jnp.ndarray,
+    branches: jnp.ndarray,
+    indices: jnp.ndarray,
+) -> jnp.ndarray:
+    """Verify B Merkle branches in ONE XLA program.
+
+    roots (B, 32) u8, leaves (B, L) u8 raw shard bytes, branches
+    (B, D, 32) u8 sibling paths bottom-up, indices (B,) u32 -> (B,) bool.
+    """
+    b, l = leaves.shape
+    d = branches.shape[1]
+    msgs = jnp.concatenate(
+        [jnp.full((b, 1), _LEAF_PREFIX_BYTE, dtype=jnp.uint8), leaves],
+        axis=1,
+    )
+    cur = sha256_batch(msgs)
+    idx = indices.astype(jnp.uint32)
+    for lvl in range(d):  # d is static: unrolled into the one program
+        sib = branches[:, lvl]
+        bit = (idx & 1).astype(bool)[:, None]
+        left = jnp.where(bit, sib, cur)
+        right = jnp.where(bit, cur, sib)
+        msgs = jnp.concatenate(
+            [jnp.full((b, 1), _NODE_PREFIX_BYTE, dtype=jnp.uint8), left, right],
+            axis=1,
+        )
+        cur = sha256_batch(msgs)
+        idx = idx >> 1
+    return (cur == roots).all(axis=1)
+
+
+__all__ = ["sha256_batch", "build_forest", "verify_branches"]

@@ -329,13 +329,18 @@ class BBA:
         self._broadcast_bval(self.round, next_est)
         # GC old round, replay parked messages for the new one
         self._rounds.pop(self.round - 1, None)
-        for sender, payload in self._future.pop(self.round, []):
+        replay_round = self.round
+        for sender, payload in self._future.pop(replay_round, []):
             cnt = self._buffered_per_sender.get(sender, 0)
             if cnt > 0:
                 self._buffered_per_sender[sender] = cnt - 1
             if self.halted:
                 break
-            self._dispatch(sender, payload)
+            # re-gate instead of dispatching blindly: a nested advance
+            # during this replay moves self.round past replay_round,
+            # and these parked votes must then be dropped as stale, not
+            # counted into a later round's quorums
+            self._gated(sender, payload, replay_round)
 
     # -- decision & termination --------------------------------------------
 

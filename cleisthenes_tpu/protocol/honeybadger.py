@@ -59,6 +59,8 @@ from cleisthenes_tpu.transport.message import (
 # lagging peers, and how far ahead a fast peer may pull us.
 KEEP_BEHIND = 2
 EPOCH_HORIZON = 8
+# epochs of committed-tx memory for lazy duplicate filtering
+COMMITTED_MEMORY_EPOCHS = 64
 
 MAX_TXS_PER_LIST = 1_000_000
 
@@ -238,7 +240,17 @@ class HoneyBadger:
         self.committed_batches: List[Batch] = []
         self.on_commit: Optional[Callable[[int, Batch], None]] = None
         self._epochs: Dict[int, _EpochState] = {}
-        self._rng = random.Random(f"{config.seed}|{node_id}")
+        # production: unpredictable sampling (censorship resistance);
+        # seeded: reproducible for tests (config.seed docs)
+        self._rng = (
+            random.SystemRandom()
+            if config.seed is None
+            else random.Random(f"{config.seed}|{node_id}")
+        )
+        # recently committed txs, for lazy dedup at candidate-poll time
+        # (bounded: one entry per remembered epoch)
+        self._committed_filter: Set[bytes] = set()
+        self._committed_history: List[Set[bytes]] = []
 
     # -- public API (reference honeybadger.go:36-59) -----------------------
 
@@ -269,8 +281,17 @@ class HoneyBadger:
         return self._select_random_txs(candidates, self.b // self.config.n)
 
     def _load_candidate_txs(self, count: int) -> List[bytes]:
-        """Poll ``count`` txs off the queue head (honeybadger.go:75-86)."""
-        return [self.que.poll() for _ in range(count)]
+        """Poll up to ``count`` txs off the queue head
+        (honeybadger.go:75-86), lazily dropping any that already
+        committed in a recent epoch (duplicate submissions — filtered
+        here at poll time instead of rewriting the whole queue on
+        every commit)."""
+        out: List[bytes] = []
+        while len(out) < count and len(self.que):
+            tx = self.que.poll()
+            if tx not in self._committed_filter:
+                out.append(tx)
+        return out
 
     def _select_random_txs(
         self, candidates: List[bytes], count: int
@@ -434,15 +455,12 @@ class HoneyBadger:
             for tx in es.my_txs:
                 if tx not in seen:
                     self.que.push(tx)
-        # drop committed txs we also hold locally (duplicate submission)
-        if len(self.que):
-            survivors = [
-                tx
-                for tx in [self.que.poll() for _ in range(len(self.que))]
-                if tx not in seen
-            ]
-            for tx in survivors:
-                self.que.push(tx)
+        # remember what committed so duplicate local submissions are
+        # dropped lazily at poll time (bounded memory)
+        self._committed_history.append(seen)
+        self._committed_filter |= seen
+        while len(self._committed_history) > COMMITTED_MEMORY_EPOCHS:
+            self._committed_filter -= self._committed_history.pop(0)
         if self.on_commit is not None:
             self.on_commit(epoch, batch)
         self._advance_epoch()

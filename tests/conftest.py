@@ -2,26 +2,33 @@
 
 Tests never require real TPU hardware: JAX is pinned to the CPU
 platform with 8 virtual devices so multi-device sharding (shard_map
-over a Mesh) is exercised exactly as it would be on a v5e slice.  This
-must run before the first ``import jax`` anywhere in the test session.
+over a Mesh) is exercised exactly as it would be on a v5e slice.
+
+The env-var route (JAX_PLATFORMS=cpu) is NOT enough here: the host
+image's sitecustomize registers the axon TPU PJRT plugin at
+interpreter boot and that registration takes precedence over the env
+var, so the platform is forced through jax.config before any test
+imports jax.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
 
 @pytest.fixture(scope="session")
 def jax_cpu_devices():
-    import jax
-
     devs = jax.devices()
     assert len(devs) == 8, f"expected 8 virtual CPU devices, got {len(devs)}"
     return devs
