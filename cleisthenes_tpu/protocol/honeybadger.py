@@ -47,6 +47,7 @@ from cleisthenes_tpu.ops.tpke import (
     Tpke,
 )
 from cleisthenes_tpu.protocol.acs import ACS
+from cleisthenes_tpu.utils.metrics import Metrics
 from cleisthenes_tpu.transport.message import (
     BbaPayload,
     CoinPayload,
@@ -206,6 +207,24 @@ class _EpochState:
         self.committed = False
 
 
+class _CountingBroadcaster:
+    """Wraps the node's PayloadBroadcaster to keep msgs_out honest
+    (one count per envelope posted)."""
+
+    def __init__(self, inner, metrics: Metrics, n_members: int) -> None:
+        self._inner = inner
+        self._metrics = metrics
+        self._n = n_members
+
+    def broadcast(self, payload) -> None:
+        self._metrics.msgs_out.inc(self._n)
+        self._inner.broadcast(payload)
+
+    def send_to(self, member_id: str, payload) -> None:
+        self._metrics.msgs_out.inc()
+        self._inner.send_to(member_id, payload)
+
+
 class HoneyBadger:
     """One validator node (reference honeybadger.go:18-34 + the absent
     epoch driver).  Implements transport.base.Handler."""
@@ -239,6 +258,8 @@ class HoneyBadger:
         self.b = max(config.batch_size, config.n)
         self.committed_batches: List[Batch] = []
         self.on_commit: Optional[Callable[[int, Batch], None]] = None
+        self.metrics = Metrics()
+        self.out = _CountingBroadcaster(out, self.metrics, len(self.members))
         self._epochs: Dict[int, _EpochState] = {}
         # production: unpredictable sampling (censorship resistance);
         # seeded: reproducible for tests (config.seed docs)
@@ -267,6 +288,7 @@ class HoneyBadger:
         if es is None or es.proposed:
             return
         es.proposed = True
+        self.metrics.epoch_proposed(self.epoch)
         es.my_txs = self._create_batch()
         ct = self.tpke.encrypt(serialize_txs(es.my_txs))
         es.acs.input(serialize_ciphertext(ct))
@@ -314,6 +336,7 @@ class HoneyBadger:
         epoch = getattr(payload, "epoch", None)
         if epoch is None:
             return
+        self.metrics.msgs_in.inc()
         es = self._epoch_state(epoch)
         if es is None:  # outside the sliding window
             return
@@ -360,6 +383,7 @@ class HoneyBadger:
         if es is None or es.output is not None:
             return
         es.output = output
+        self.metrics.epoch_acs_output(epoch)
         for proposer, ct_bytes in output.items():
             try:
                 ct = deserialize_ciphertext(ct_bytes)
@@ -450,6 +474,7 @@ class HoneyBadger:
                 contributions[proposer] = mine
         batch = Batch(contributions=contributions)
         self.committed_batches.append(batch)
+        self.metrics.epoch_committed(epoch, len(batch))
         # re-queue our own txs that did not make it into the set
         if es.proposed:
             for tx in es.my_txs:

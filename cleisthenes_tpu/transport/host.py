@@ -1,0 +1,268 @@
+"""ValidatorHost: one HBBFT validator over the gRPC transport.
+
+Bundles what an embedding application wires by hand in the reference
+(its README's server/client/pool snippets): a GrpcServer accepting
+peer streams, dialed client connections to every roster member, and
+the HoneyBadger node — plus the piece the reference gets from Go's
+runtime for free: a per-node *serial dispatcher*.  gRPC gives every
+peer stream its own reader thread, but the protocol state machines are
+single-threaded actors (the reference muxes everything through
+reqChan loops, bba/bba.go:113-123); ``SerialDispatcher`` is that actor
+loop at node level — every inbound message and every local command
+funnels through one worker thread, so protocol code never needs locks.
+
+Self-delivery bypasses the network: a node's own broadcasts are
+enqueued straight onto its dispatcher (the in-proc transport routes
+them through the scheduler instead; both count the node as a normal
+quorum member).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+from cleisthenes_tpu.config import Config
+from cleisthenes_tpu.core.batch import Batch
+from cleisthenes_tpu.protocol.honeybadger import HoneyBadger, NodeKeys
+from cleisthenes_tpu.transport.base import (
+    ConnectionPool,
+    HmacAuthenticator,
+)
+from cleisthenes_tpu.transport.grpc_net import (
+    DialOpts,
+    GrpcClient,
+    GrpcConnection,
+    GrpcServer,
+)
+from cleisthenes_tpu.transport.message import Message, Payload
+
+
+class SerialDispatcher:
+    """Node-level actor loop: serializes message dispatch and local
+    commands onto one worker thread (the node's reqChan)."""
+
+    def __init__(self, name: str = "dispatch") -> None:
+        self._q: "queue.Queue" = queue.Queue()
+        self._handler = None
+        self._thread = threading.Thread(
+            target=self._loop, name=name, daemon=True
+        )
+        self._stopped = threading.Event()
+        self._thread.start()
+
+    def bind(self, handler) -> None:
+        self._handler = handler
+
+    # transport Handler interface: called from gRPC reader threads
+    def serve_request(self, msg: Message) -> None:
+        if not self._stopped.is_set():
+            self._q.put(msg)
+
+    def call(self, fn: Callable[[], None]) -> None:
+        """Run ``fn`` on the dispatch thread (local commands mutate
+        protocol state, so they take the same door as messages)."""
+        if not self._stopped.is_set():
+            self._q.put(fn)
+
+    def call_sync(self, fn: Callable[[], object], timeout: float = 30.0):
+        """``call`` and wait for the result (for inspection APIs)."""
+        if self._stopped.is_set():
+            raise RuntimeError("dispatcher stopped")
+        done = threading.Event()
+        box: List[object] = []
+
+        def run():
+            try:
+                box.append(fn())
+            finally:
+                done.set()
+
+        self.call(run)
+        if not done.wait(timeout):
+            raise TimeoutError("dispatcher stalled")
+        return box[0] if box else None
+
+    def drain(self, timeout: float = 30.0) -> None:
+        """Block until everything queued so far has been processed."""
+        self.call_sync(lambda: None, timeout=timeout)
+
+    def _loop(self) -> None:
+        while not self._stopped.is_set():
+            item = self._q.get()
+            if item is None:
+                return
+            try:
+                if callable(item):
+                    item()
+                elif self._handler is not None:
+                    self._handler.serve_request(item)
+            except Exception:
+                # a poisoned message must not kill the node's actor
+                import traceback
+
+                traceback.print_exc()
+
+    def stop(self) -> None:
+        self._stopped.set()
+        self._q.put(None)
+
+
+class GrpcPayloadBroadcaster:
+    """PayloadBroadcaster over dialed peer connections + local
+    short-circuit (transport.broadcast.ChannelBroadcaster's gRPC twin).
+
+    Broadcasts sign+encode ONCE and fan the identical wire frame to
+    every peer (signing_bytes is deterministic, so per-connection
+    re-signing would produce the same bytes n-1 times)."""
+
+    def __init__(
+        self,
+        node_id: str,
+        pool: ConnectionPool,
+        local: SerialDispatcher,
+        auth,
+    ) -> None:
+        self._node_id = node_id
+        self._pool = pool
+        self._local = local
+        self._auth = auth
+
+    def _wrap(self, payload: Payload) -> Message:
+        return Message(
+            sender_id=self._node_id, timestamp=time.time(), payload=payload
+        )
+
+    def broadcast(self, payload: Payload) -> None:
+        from cleisthenes_tpu.transport.message import encode_message
+
+        msg = self._wrap(payload)
+        wire = encode_message(self._auth.sign(msg))
+        for conn in self._pool.get_all():
+            conn.send_wire(wire)
+        self._local.serve_request(msg)
+
+    def send_to(self, member_id: str, payload: Payload) -> None:
+        msg = self._wrap(payload)
+        if member_id == self._node_id:
+            self._local.serve_request(msg)
+        else:
+            self._pool.send_to(member_id, msg)
+
+
+class ValidatorHost:
+    """One validator process: server + peer dials + HoneyBadger node."""
+
+    def __init__(
+        self,
+        config: Config,
+        node_id: str,
+        member_ids: Sequence[str],
+        keys: NodeKeys,
+        listen_addr: str = "127.0.0.1:0",
+        auto_propose: bool = True,
+    ) -> None:
+        self.config = config
+        self.node_id = node_id
+        self.members = sorted(member_ids)
+        self.keys = keys
+        self._auth = HmacAuthenticator(keys.mac_master, node_id)
+        # inbound verification is sender-keyed, so one authenticator
+        # verifies all peers; signing is bound to node_id
+        self.dispatcher = SerialDispatcher(name=f"dispatch-{node_id}")
+        self.server = GrpcServer(
+            listen_addr, self._auth, capacity=config.channel_capacity
+        )
+        self.server.on_conn(self._accept)
+        self.pool = ConnectionPool()
+        self._client = GrpcClient(self._auth)
+        self.out = GrpcPayloadBroadcaster(
+            node_id, self.pool, self.dispatcher, self._auth
+        )
+        self.node = HoneyBadger(
+            config=config,
+            node_id=node_id,
+            member_ids=self.members,
+            keys=keys,
+            out=self.out,
+            auto_propose=auto_propose,
+        )
+        self.dispatcher.bind(self.node)
+        self._commits: "queue.Queue" = queue.Queue()
+        self.node.on_commit = lambda epoch, batch: self._commits.put(
+            (epoch, batch)
+        )
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def _accept(self, conn: GrpcConnection) -> None:
+        """Server-side stream accepted: route into the dispatcher
+        (the reference's connHandler contract, comm.go:47-49)."""
+        conn.handle(self.dispatcher)
+        conn.start()
+
+    def listen(self) -> str:
+        self.server.listen()
+        return f"127.0.0.1:{self.server.port}"
+
+    def connect(
+        self, addrs: Dict[str, str], deadline_s: float = 10.0
+    ) -> None:
+        """Dial every other roster member, retrying until deadline
+        (peers boot concurrently)."""
+        t0 = time.monotonic()
+        for member in self.members:
+            if member == self.node_id:
+                continue
+            while True:
+                try:
+                    conn = self._client.dial(
+                        DialOpts(
+                            addrs[member],
+                            timeout_s=self.config.dial_timeout_s,
+                            capacity=self.config.channel_capacity,
+                            conn_id=member,  # pool addressed by member
+                        )
+                    )
+                    break
+                except Exception:
+                    if time.monotonic() - t0 > deadline_s:
+                        raise
+                    time.sleep(0.05)
+            conn.handle(self.dispatcher)
+            conn.start()
+            self.pool.add(conn)
+
+    def stop(self) -> None:
+        self.server.stop()
+        self._client.close()
+        self.dispatcher.stop()
+
+    # -- application API ---------------------------------------------------
+
+    def submit(self, tx: bytes) -> None:
+        self.node.add_transaction(tx)  # queue is internally locked
+
+    def propose(self) -> None:
+        self.dispatcher.call(self.node.start_epoch)
+
+    def wait_commit(self, timeout: float = 30.0):
+        """Block for the next committed (epoch, Batch)."""
+        return self._commits.get(timeout=timeout)
+
+    def committed_batches(self) -> List[Batch]:
+        return self.dispatcher.call_sync(
+            lambda: list(self.node.committed_batches)
+        )
+
+    def pending_tx_count(self) -> int:
+        return self.node.pending_tx_count()
+
+
+__all__ = [
+    "SerialDispatcher",
+    "GrpcPayloadBroadcaster",
+    "ValidatorHost",
+]

@@ -1,0 +1,10 @@
+"""Cross-cutting utilities: metrics, tracing, deterministic helpers."""
+
+from cleisthenes_tpu.utils.metrics import (
+    Counter,
+    EpochTrace,
+    Histogram,
+    Metrics,
+)
+
+__all__ = ["Counter", "Histogram", "EpochTrace", "Metrics"]

@@ -1,0 +1,177 @@
+"""Metrics & tracing: the observability the reference lacks.
+
+SURVEY.md §5.1/§5.5: the reference's only observability is three log
+lines on listen errors (reference comm.go:82,92,95) — no metrics
+registry, no per-epoch timing, even though the BASELINE metric is
+"tx/sec & epoch p50".  This module provides exactly that: counters,
+streaming histograms with percentiles, and per-epoch phase traces
+(propose -> ACS output -> commit), cheap enough to stay always-on.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+class Counter:
+    """Monotonic counter (thread-safe)."""
+
+    def __init__(self) -> None:
+        self._v = 0
+        self._lock = threading.Lock()
+
+    def inc(self, by: int = 1) -> None:
+        with self._lock:
+            self._v += by
+
+    @property
+    def value(self) -> int:
+        return self._v
+
+
+class Histogram:
+    """Sorted-reservoir histogram with exact percentiles.
+
+    Bounded: keeps the most recent ``cap`` observations (epoch
+    latencies arrive at network pace, so thousands of samples cover
+    hours of operation)."""
+
+    def __init__(self, cap: int = 4096) -> None:
+        self._sorted: List[float] = []
+        self._ring: List[float] = []
+        self._cap = cap
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            if len(self._ring) >= self._cap:
+                old = self._ring.pop(0)
+                idx = bisect.bisect_left(self._sorted, old)
+                self._sorted.pop(idx)
+            self._ring.append(v)
+            bisect.insort(self._sorted, v)
+
+    def percentile(self, p: float) -> Optional[float]:
+        """p in [0, 100]; None when empty."""
+        with self._lock:
+            if not self._sorted:
+                return None
+            idx = min(
+                len(self._sorted) - 1,
+                int(round((p / 100.0) * (len(self._sorted) - 1))),
+            )
+            return self._sorted[idx]
+
+    @property
+    def count(self) -> int:
+        return len(self._ring)
+
+    @property
+    def p50(self) -> Optional[float]:
+        return self.percentile(50)
+
+    @property
+    def p95(self) -> Optional[float]:
+        return self.percentile(95)
+
+
+class EpochTrace:
+    """Phase timestamps for one epoch: propose -> acs_output -> commit
+    (the per-epoch phase timing of SURVEY.md §5.1)."""
+
+    __slots__ = ("epoch", "t_propose", "t_acs_output", "t_commit", "n_txs")
+
+    def __init__(self, epoch: int):
+        self.epoch = epoch
+        self.t_propose: Optional[float] = None
+        self.t_acs_output: Optional[float] = None
+        self.t_commit: Optional[float] = None
+        self.n_txs: int = 0
+
+    @property
+    def total_s(self) -> Optional[float]:
+        if self.t_propose is None or self.t_commit is None:
+            return None
+        return self.t_commit - self.t_propose
+
+    @property
+    def acs_s(self) -> Optional[float]:
+        if self.t_propose is None or self.t_acs_output is None:
+            return None
+        return self.t_acs_output - self.t_propose
+
+    @property
+    def decrypt_s(self) -> Optional[float]:
+        if self.t_acs_output is None or self.t_commit is None:
+            return None
+        return self.t_commit - self.t_acs_output
+
+
+class Metrics:
+    """Per-node metrics registry."""
+
+    def __init__(self, trace_cap: int = 1024) -> None:
+        self.msgs_in = Counter()
+        self.msgs_out = Counter()
+        self.epochs_committed = Counter()
+        self.txs_committed = Counter()
+        self.epoch_latency = Histogram()  # seconds, propose -> commit
+        self.acs_latency = Histogram()
+        self.decrypt_latency = Histogram()
+        self._traces: Dict[int, EpochTrace] = {}
+        self._trace_cap = trace_cap
+        self._t0 = time.monotonic()
+        self._lock = threading.Lock()
+
+    def trace(self, epoch: int) -> EpochTrace:
+        with self._lock:
+            tr = self._traces.get(epoch)
+            if tr is None:
+                tr = EpochTrace(epoch)
+                self._traces[epoch] = tr
+                if len(self._traces) > self._trace_cap:
+                    del self._traces[min(self._traces)]
+            return tr
+
+    def epoch_proposed(self, epoch: int) -> None:
+        self.trace(epoch).t_propose = time.monotonic()
+
+    def epoch_acs_output(self, epoch: int) -> None:
+        self.trace(epoch).t_acs_output = time.monotonic()
+
+    def epoch_committed(self, epoch: int, n_txs: int) -> None:
+        tr = self.trace(epoch)
+        tr.t_commit = time.monotonic()
+        tr.n_txs = n_txs
+        self.epochs_committed.inc()
+        self.txs_committed.inc(n_txs)
+        if tr.total_s is not None:
+            self.epoch_latency.observe(tr.total_s)
+        if tr.acs_s is not None:
+            self.acs_latency.observe(tr.acs_s)
+        if tr.decrypt_s is not None:
+            self.decrypt_latency.observe(tr.decrypt_s)
+
+    def tx_per_sec(self) -> float:
+        dt = time.monotonic() - self._t0
+        return self.txs_committed.value / dt if dt > 0 else 0.0
+
+    def snapshot(self) -> Dict[str, object]:
+        """One flat dict for logging/export (the BASELINE metrics)."""
+        return {
+            "msgs_in": self.msgs_in.value,
+            "msgs_out": self.msgs_out.value,
+            "epochs_committed": self.epochs_committed.value,
+            "txs_committed": self.txs_committed.value,
+            "tx_per_sec": round(self.tx_per_sec(), 3),
+            "epoch_p50_s": self.epoch_latency.p50,
+            "epoch_p95_s": self.epoch_latency.p95,
+            "acs_p50_s": self.acs_latency.p50,
+            "decrypt_p50_s": self.decrypt_latency.p50,
+        }
+
+
+__all__ = ["Counter", "Histogram", "EpochTrace", "Metrics"]
