@@ -34,9 +34,9 @@ class Config:
         effective per-node proposal is B/N randomly sampled from the
         head of the queue (reference honeybadger.go:36-49,62-104;
         docs/HONEYBADGER-EN.md:49-56).
-      crypto_backend: 'cpu' (numpy + native C++ reference path) or
-        'tpu' (batched JAX/XLA kernels) — the BatchCrypto/ErasureCoder
-        seam from BASELINE.json.
+      crypto_backend: 'cpu' (numpy reference), 'cpp' (native compiled
+        GF kernels) or 'tpu' (batched JAX/XLA kernels) — the
+        BatchCrypto/ErasureCoder seam from BASELINE.json.
       dial_timeout_s: client dial timeout (reference comm.go:107-109).
       channel_capacity: per-connection mailbox depth (conn.go:60-61).
       seed: None (default) draws batch-sampling randomness from the OS
@@ -72,7 +72,7 @@ class Config:
                 f"n={self.n} must be >= 3f+1={3 * self.f + 1} "
                 "(docs/BBA-EN.md:26: t < n/3)"
             )
-        if self.crypto_backend not in ("cpu", "tpu"):
+        if self.crypto_backend not in ("cpu", "cpp", "tpu"):
             raise ValueError(f"unknown crypto_backend {self.crypto_backend!r}")
 
     @property

@@ -1,0 +1,11 @@
+"""Native (C++) kernels, loaded via ctypes.
+
+The shared library is compiled on demand with the system toolchain and
+cached next to the sources (or in a per-user cache dir if the package
+is read-only).  Everything degrades gracefully: if no compiler is
+available the callers fall back to the numpy reference backend.
+"""
+
+from cleisthenes_tpu.native.build import load_gf256, native_available
+
+__all__ = ["load_gf256", "native_available"]

@@ -1,0 +1,75 @@
+"""On-demand compilation + ctypes loading of the native kernels."""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+from pathlib import Path
+from typing import Optional
+
+_SRC = Path(__file__).with_name("gf256.cpp")
+_LIB_CACHE: Optional[ctypes.CDLL] = None
+_LOAD_FAILED = False
+
+
+def _cache_path() -> Path:
+    """Library path keyed by source hash (rebuilds on source change)."""
+    digest = hashlib.sha256(_SRC.read_bytes()).hexdigest()[:16]
+    name = f"_gf256-{digest}.so"
+    local = _SRC.parent / name
+    if os.access(_SRC.parent, os.W_OK):
+        return local
+    cache_dir = Path(tempfile.gettempdir()) / "cleisthenes_tpu_native"
+    cache_dir.mkdir(parents=True, exist_ok=True)
+    return cache_dir / name
+
+
+def _compile(out: Path) -> None:
+    tmp = out.with_suffix(".tmp.so")
+    cmd = [
+        "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+        "-funroll-loops", str(_SRC), "-o", str(tmp),
+    ]
+    subprocess.run(
+        cmd, check=True, capture_output=True, timeout=120
+    )
+    tmp.replace(out)  # atomic: concurrent builders race benignly
+
+
+def load_gf256() -> Optional[ctypes.CDLL]:
+    """The compiled library, or None if unavailable (no toolchain)."""
+    global _LIB_CACHE, _LOAD_FAILED
+    if _LIB_CACHE is not None or _LOAD_FAILED:
+        return _LIB_CACHE
+    try:
+        path = _cache_path()
+        if not path.exists():
+            _compile(path)
+        lib = ctypes.CDLL(str(path))
+        lib.gf256_matmul.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ]
+        lib.gf256_matmul_batch.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ]
+        lib.gf256_selftest.restype = ctypes.c_int
+        rc = lib.gf256_selftest()
+        if rc != 0:
+            raise RuntimeError(f"gf256 selftest failed: {rc}")
+        _LIB_CACHE = lib
+    except Exception:
+        _LOAD_FAILED = True
+        _LIB_CACHE = None
+    return _LIB_CACHE
+
+
+def native_available() -> bool:
+    return load_gf256() is not None
+
+
+__all__ = ["load_gf256", "native_available"]

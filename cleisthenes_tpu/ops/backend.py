@@ -81,6 +81,10 @@ def make_erasure_coder(backend: str, n: int, k: int) -> ErasureCoder:
         from cleisthenes_tpu.ops.rs_cpu import CpuErasureCoder
 
         return CpuErasureCoder(n, k)
+    if backend == "cpp":
+        from cleisthenes_tpu.ops.rs_cpp import CppErasureCoder
+
+        return CppErasureCoder(n, k)
     if backend == "tpu":
         from cleisthenes_tpu.ops.rs_xla import XlaErasureCoder
 
@@ -104,20 +108,27 @@ class BatchCrypto:
         self.f = f
         self.k = k
         self.erasure = make_erasure_coder(backend, n, k)
-        self.merkle = make_merkle(backend)
+        # the native backend accelerates the GF plane; hashing and
+        # modexp stay on their cpu reference implementations
+        self.merkle = make_merkle("cpu" if backend == "cpp" else backend)
+
+    @property
+    def engine_backend(self) -> str:
+        """Backend name for the modexp engine (tpke/coin verify)."""
+        return "cpu" if self.backend == "cpp" else self.backend
 
     def tpke(self, pub):
         """Threshold-decryption service bound to this backend
         (pub: tpke.ThresholdPublicKey)."""
         from cleisthenes_tpu.ops.tpke import Tpke
 
-        return Tpke(pub, backend=self.backend)
+        return Tpke(pub, backend=self.engine_backend)
 
     def coin(self, pub):
         """Common-coin service bound to this backend."""
         from cleisthenes_tpu.ops.coin import CommonCoin
 
-        return CommonCoin(pub, backend=self.backend)
+        return CommonCoin(pub, backend=self.engine_backend)
 
 
 def get_backend(config) -> BatchCrypto:

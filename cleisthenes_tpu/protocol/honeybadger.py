@@ -245,12 +245,11 @@ class HoneyBadger:
         if node_id not in self.members:
             raise ValueError(f"{node_id!r} not in roster")
         self.keys = keys
-        self.out = out
         self.auto_propose = auto_propose
 
         self.crypto: BatchCrypto = get_backend(config)
-        self.tpke = Tpke(keys.tpke_pub, backend=config.crypto_backend)
-        self.coin = CommonCoin(keys.coin_pub, backend=config.crypto_backend)
+        self.tpke = self.crypto.tpke(keys.tpke_pub)
+        self.coin = self.crypto.coin(keys.coin_pub)
 
         self.que = TxQueue()
         self.epoch = 0

@@ -264,8 +264,7 @@ class GrpcServer:
             err = RuntimeError(f"could not bind {self.addr}")
             if self._on_err is not None:
                 self._on_err(err)
-                return
-            raise err
+            raise err  # never leave the caller with a dead server
         self._server.start()
 
     def stop(self, grace: float = 0.5) -> None:

@@ -129,19 +129,46 @@ class GrpcPayloadBroadcaster:
         self._pool = pool
         self._local = local
         self._auth = auth
+        # until connect() finishes, the pool is incomplete: park
+        # outbound traffic instead of silently dropping it for peers
+        # not dialed yet (protocol messages are sent exactly once)
+        self._ready = False
+        self._pending: List = []
+        self._lock = threading.Lock()
+
+    def mark_ready(self) -> None:
+        with self._lock:
+            self._ready = True
+            pending, self._pending = self._pending, []
+        for member_id, msg in pending:
+            self._deliver(member_id, msg)
 
     def _wrap(self, payload: Payload) -> Message:
         return Message(
             sender_id=self._node_id, timestamp=time.time(), payload=payload
         )
 
-    def broadcast(self, payload: Payload) -> None:
+    def _deliver(self, member_id: Optional[str], msg: Message) -> None:
+        """member_id None = broadcast to all peers."""
         from cleisthenes_tpu.transport.message import encode_message
 
+        if member_id is None:
+            wire = encode_message(self._auth.sign(msg))
+            for conn in self._pool.get_all():
+                conn.send_wire(wire)
+        else:
+            self._pool.send_to(member_id, msg)
+
+    def _post(self, member_id: Optional[str], msg: Message) -> None:
+        with self._lock:
+            if not self._ready:
+                self._pending.append((member_id, msg))
+                return
+        self._deliver(member_id, msg)
+
+    def broadcast(self, payload: Payload) -> None:
         msg = self._wrap(payload)
-        wire = encode_message(self._auth.sign(msg))
-        for conn in self._pool.get_all():
-            conn.send_wire(wire)
+        self._post(None, msg)
         self._local.serve_request(msg)
 
     def send_to(self, member_id: str, payload: Payload) -> None:
@@ -149,7 +176,7 @@ class GrpcPayloadBroadcaster:
         if member_id == self._node_id:
             self._local.serve_request(msg)
         else:
-            self._pool.send_to(member_id, msg)
+            self._post(member_id, msg)
 
 
 class ValidatorHost:
@@ -168,6 +195,8 @@ class ValidatorHost:
         self.node_id = node_id
         self.members = sorted(member_ids)
         self.keys = keys
+        self._addrs: Dict[str, str] = {}
+        self._stopping = threading.Event()
         self._auth = HmacAuthenticator(keys.mac_master, node_id)
         # inbound verification is sender-keyed, so one authenticator
         # verifies all peers; signing is bound to node_id
@@ -211,31 +240,71 @@ class ValidatorHost:
         self, addrs: Dict[str, str], deadline_s: float = 10.0
     ) -> None:
         """Dial every other roster member, retrying until deadline
-        (peers boot concurrently)."""
+        (peers boot concurrently).  Buffered outbound traffic flushes
+        once the pool is complete."""
+        missing = set(self.members) - {self.node_id} - set(addrs)
+        if missing:  # config error: fail fast, don't spin the retry loop
+            raise ValueError(f"no address for roster members {sorted(missing)}")
+        self._addrs = dict(addrs)
         t0 = time.monotonic()
         for member in self.members:
             if member == self.node_id:
                 continue
-            while True:
-                try:
-                    conn = self._client.dial(
-                        DialOpts(
-                            addrs[member],
-                            timeout_s=self.config.dial_timeout_s,
-                            capacity=self.config.channel_capacity,
-                            conn_id=member,  # pool addressed by member
-                        )
+            self._dial_member(
+                member, lambda: time.monotonic() - t0 > deadline_s
+            )
+        self.out.mark_ready()
+
+    def _dial_member(self, member: str, expired) -> None:
+        while True:
+            try:
+                conn = self._client.dial(
+                    DialOpts(
+                        self._addrs[member],
+                        timeout_s=self.config.dial_timeout_s,
+                        capacity=self.config.channel_capacity,
+                        conn_id=member,  # pool addressed by member
                     )
-                    break
-                except Exception:
-                    if time.monotonic() - t0 > deadline_s:
-                        raise
-                    time.sleep(0.05)
-            conn.handle(self.dispatcher)
-            conn.start()
-            self.pool.add(conn)
+                )
+                break
+            except Exception:
+                if expired():
+                    raise
+                time.sleep(0.05)
+        conn.handle(self.dispatcher)
+        # a broken stream prunes itself from the pool and redials in
+        # the background (messages sent while down are lost; HBBFT's
+        # f-tolerance covers short outages, reconnection restores the
+        # peer for later epochs).  Chain the dial-layer close hook
+        # (it cancels the underlying gRPC call).
+        cancel_call = conn._on_close
+        conn._on_close = lambda c, m=member, cc=cancel_call: (
+            cc(c) if cc else None,
+            self._on_conn_lost(m, c),
+        )
+        conn.start()
+        self.pool.add(conn)
+
+    def _on_conn_lost(self, member: str, conn) -> None:
+        self.pool.remove(member)
+        if self._stopping.is_set():
+            return
+        threading.Thread(
+            target=self._redial_loop, args=(member,), daemon=True
+        ).start()
+
+    def _redial_loop(self, member: str) -> None:
+        backoff = 0.1
+        while not self._stopping.is_set():
+            try:
+                self._dial_member(member, self._stopping.is_set)
+                return
+            except Exception:
+                time.sleep(backoff)
+                backoff = min(backoff * 2, 5.0)
 
     def stop(self) -> None:
+        self._stopping.set()
         self.server.stop()
         self._client.close()
         self.dispatcher.stop()

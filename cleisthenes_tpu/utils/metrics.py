@@ -11,6 +11,7 @@ streaming histograms with percentiles, and per-epoch phase traces
 from __future__ import annotations
 
 import bisect
+import collections
 import threading
 import time
 from typing import Dict, List, Optional
@@ -41,14 +42,14 @@ class Histogram:
 
     def __init__(self, cap: int = 4096) -> None:
         self._sorted: List[float] = []
-        self._ring: List[float] = []
+        self._ring: "collections.deque[float]" = collections.deque()
         self._cap = cap
         self._lock = threading.Lock()
 
     def observe(self, v: float) -> None:
         with self._lock:
             if len(self._ring) >= self._cap:
-                old = self._ring.pop(0)
+                old = self._ring.popleft()
                 idx = bisect.bisect_left(self._sorted, old)
                 self._sorted.pop(idx)
             self._ring.append(v)

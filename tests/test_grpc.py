@@ -181,3 +181,52 @@ def test_hbbft_over_real_grpc_network(n_epochs_min):
     finally:
         for h in hosts.values():
             h.stop()
+
+
+def test_broadcaster_buffers_until_ready():
+    """Outbound traffic before connect() completes must be parked and
+    flushed, not dropped (peers boot concurrently)."""
+    from cleisthenes_tpu.transport.base import (
+        ConnectionPool,
+        NullAuthenticator,
+    )
+    from cleisthenes_tpu.transport.host import (
+        GrpcPayloadBroadcaster,
+        SerialDispatcher,
+    )
+
+    sent = []
+
+    class FakeConn:
+        def __init__(self, cid):
+            self._cid = cid
+
+        def id(self):
+            return self._cid
+
+        def send_wire(self, wire):
+            sent.append(("wire", self._cid))
+            return True
+
+        def send(self, msg, on_success=None, on_err=None):
+            sent.append(("msg", self._cid))
+
+    disp = SerialDispatcher()
+    pool = ConnectionPool()
+    out = GrpcPayloadBroadcaster("a", pool, disp, NullAuthenticator())
+
+    msg_payload = _val_msg("a").payload
+    out.broadcast(msg_payload)  # pool still empty, not ready
+    out.send_to("b", msg_payload)
+    assert sent == []  # nothing dropped into the void
+
+    pool.add(FakeConn("b"))
+    pool.add(FakeConn("c"))
+    out.mark_ready()
+    kinds = sorted(sent)
+    assert ("msg", "b") in kinds  # the queued send_to flushed
+    assert kinds.count(("wire", "b")) == 1 and kinds.count(("wire", "c")) == 1
+    sent.clear()
+    out.broadcast(msg_payload)  # post-ready goes straight through
+    assert len(sent) == 2
+    disp.stop()

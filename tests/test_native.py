@@ -1,0 +1,104 @@
+"""Native C++ GF(2^8) kernel tests: property-tested against the numpy
+reference backend, plus a full HBBFT epoch on crypto_backend='cpp'."""
+
+import numpy as np
+import pytest
+
+from cleisthenes_tpu.native.build import native_available
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="no C++ toolchain"
+)
+
+
+def test_native_selftest_passes():
+    from cleisthenes_tpu.native.build import load_gf256
+
+    assert load_gf256().gf256_selftest() == 0
+
+
+@pytest.mark.parametrize("n,k", [(4, 2), (7, 3), (16, 6), (64, 22)])
+def test_cpp_encode_matches_numpy(n, k):
+    from cleisthenes_tpu.ops.rs_cpp import CppErasureCoder
+    from cleisthenes_tpu.ops.rs_cpu import CpuErasureCoder
+
+    rng = np.random.default_rng(n * 100 + k)
+    data = rng.integers(0, 256, size=(k, 384), dtype=np.uint8)
+    assert np.array_equal(
+        CppErasureCoder(n, k).encode(data), CpuErasureCoder(n, k).encode(data)
+    )
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_cpp_decode_roundtrip_any_k_survivors(seed):
+    from cleisthenes_tpu.ops.rs_cpp import CppErasureCoder
+
+    rng = np.random.default_rng(seed)
+    n, k = 10, 4
+    coder = CppErasureCoder(n, k)
+    data = rng.integers(0, 256, size=(k, 200), dtype=np.uint8)
+    full = coder.encode(data)
+    survivors = sorted(rng.choice(n, size=k, replace=False).tolist())
+    out = coder.decode(survivors, full[survivors])
+    assert np.array_equal(out, data)
+
+
+def test_cpp_encode_batch_matches_single():
+    from cleisthenes_tpu.ops.rs_cpp import CppErasureCoder
+
+    rng = np.random.default_rng(3)
+    n, k, b = 8, 4, 5
+    coder = CppErasureCoder(n, k)
+    data = rng.integers(0, 256, size=(b, k, 128), dtype=np.uint8)
+    batched = coder.encode_batch(data)
+    for i in range(b):
+        assert np.array_equal(batched[i], coder.encode(data[i]))
+
+
+def test_backend_registry_exposes_cpp():
+    from cleisthenes_tpu.config import Config
+    from cleisthenes_tpu.ops.backend import get_backend
+
+    cfg = Config(n=4, crypto_backend="cpp")
+    crypto = get_backend(cfg)
+    assert crypto.engine_backend == "cpu"
+    data = np.arange(2 * 128, dtype=np.uint8).reshape(2, 128)
+    full = crypto.erasure.encode(data)
+    assert np.array_equal(
+        crypto.erasure.decode([2, 3], full[2:4]), data
+    )
+
+
+def test_hbbft_epoch_on_cpp_backend():
+    from tests.test_honeybadger import (
+        assert_identical_batches,
+        make_hb_network,
+        push_txs,
+    )
+    from cleisthenes_tpu.config import Config
+    from cleisthenes_tpu.protocol.honeybadger import setup_keys
+    from cleisthenes_tpu.transport.base import HmacAuthenticator
+    from cleisthenes_tpu.transport.broadcast import ChannelBroadcaster
+    from cleisthenes_tpu.transport.channel import ChannelNetwork
+    from cleisthenes_tpu.protocol.honeybadger import HoneyBadger
+
+    cfg = Config(n=4, batch_size=8, crypto_backend="cpp")
+    ids = [f"node{i}" for i in range(4)]
+    keys = setup_keys(cfg, ids, seed=11)
+    net = ChannelNetwork()
+    nodes = {}
+    for node_id in ids:
+        hb = HoneyBadger(
+            config=cfg,
+            node_id=node_id,
+            member_ids=ids,
+            keys=keys[node_id],
+            out=ChannelBroadcaster(net, node_id, ids),
+        )
+        nodes[node_id] = hb
+        net.join(node_id, hb, HmacAuthenticator(keys[node_id].mac_master, node_id))
+    push_txs(nodes, 8)
+    for hb in nodes.values():
+        hb.start_epoch()
+    net.run()
+    assert_identical_batches(nodes)
