@@ -2,8 +2,10 @@
 
 The shared library is compiled on demand with the system toolchain and
 cached next to the sources (or in a per-user cache dir if the package
-is read-only).  Everything degrades gracefully: if no compiler is
-available the callers fall back to the numpy reference backend.
+is read-only).  ``native_available()`` reports whether the toolchain
+worked; selecting crypto_backend='cpp' without it is fail-fast
+(CppErasureCoder raises) — callers that want degradation should check
+``native_available()`` and choose 'cpu' themselves.
 """
 
 from cleisthenes_tpu.native.build import load_gf256, native_available

@@ -28,7 +28,9 @@ def _cache_path() -> Path:
 
 
 def _compile(out: Path) -> None:
-    tmp = out.with_suffix(".tmp.so")
+    # per-process tmp name: concurrent first-time builders must not
+    # interleave writes before the atomic rename
+    tmp = out.with_suffix(f".tmp{os.getpid()}.so")
     cmd = [
         "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
         "-funroll-loops", str(_SRC), "-o", str(tmp),

@@ -110,7 +110,7 @@ class BatchCrypto:
         self.erasure = make_erasure_coder(backend, n, k)
         # the native backend accelerates the GF plane; hashing and
         # modexp stay on their cpu reference implementations
-        self.merkle = make_merkle("cpu" if backend == "cpp" else backend)
+        self.merkle = make_merkle(self.engine_backend)
 
     @property
     def engine_backend(self) -> str:

@@ -37,9 +37,11 @@ from cleisthenes_tpu.transport.message import (
 )
 
 # A fault filter sees (sender_id, receiver_id, wire_bytes) and returns
-# the bytes to deliver, or None to drop.  Tampering is modeled by
+# what to deliver: bytes (pass/tamper), None (drop), or a list of
+# byte-strings (duplication / replay injection — the first delivers
+# now, the rest re-enter the pending queue).  Tampering is modeled by
 # returning different bytes — which the Authenticator then catches.
-FaultFilter = Callable[[str, str, bytes], Optional[bytes]]
+FaultFilter = Callable[[str, str, bytes], "Optional[bytes] | list"]
 
 
 class ChannelEndpoint:
@@ -167,7 +169,7 @@ class ChannelNetwork:
         wire = encode_message(signed)
         self.messages_posted += 1
         self.bytes_posted += len(wire)
-        self._pending.append((sender_id, receiver_id, wire))
+        self._pending.append((sender_id, receiver_id, wire, False))
 
     def pending_count(self) -> int:
         return len(self._pending)
@@ -181,22 +183,35 @@ class ChannelNetwork:
         """
         while self._pending:
             if self._rng is None:
-                sender, receiver, wire = self._pending.popleft()
+                sender, receiver, wire, prefiltered = self._pending.popleft()
             else:
                 idx = self._rng.randrange(len(self._pending))
                 item = self._pending[idx]
                 self._pending[idx] = self._pending[-1]
                 self._pending.pop()
-                sender, receiver, wire = item
+                sender, receiver, wire, prefiltered = item
             if receiver in self._crashed or sender in self._crashed:
                 continue
             if (sender, receiver) in self._partitions:
                 continue
-            if self.fault_filter is not None:
+            if self.fault_filter is not None and not prefiltered:
                 maybe = self.fault_filter(sender, receiver, wire)
                 if maybe is None:
                     continue
-                wire = maybe
+                if isinstance(maybe, list):
+                    if not maybe:
+                        continue
+                    wire = maybe[0]
+                    # duplicates / injections: deliver later WITHOUT
+                    # re-filtering (a filtered frame re-entering the
+                    # filter would branch exponentially)
+                    for extra in maybe[1:]:
+                        if len(self._pending) < self._queue_capacity:
+                            self._pending.append(
+                                (sender, receiver, extra, True)
+                            )
+                else:
+                    wire = maybe
             ep = self._endpoints.get(receiver)
             if ep is None:
                 continue

@@ -323,7 +323,23 @@ class GrpcClient:
         )
         call = multi(conn.outbound())
         conn._inbound = call
-        conn._on_close = lambda c: call.cancel()
+
+        def cleanup(_c, ch=channel, call=call):
+            # release the channel with its stream: redial cycles must
+            # not accumulate live channels (sockets + threads)
+            try:
+                call.cancel()
+            finally:
+                try:
+                    ch.close()
+                except Exception:
+                    pass
+                try:
+                    self._channels.remove(ch)
+                except ValueError:
+                    pass
+
+        conn._on_close = cleanup
         return conn
 
     def close(self) -> None:

