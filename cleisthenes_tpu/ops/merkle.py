@@ -218,7 +218,15 @@ class XlaMerkle(MerkleBackend):
             shards = np.concatenate(
                 [shards, np.zeros((bucket - b,) + shards.shape[1:], np.uint8)]
             )
-        levels = [np.asarray(lvl) for lvl in build_forest(jnp.asarray(shards))]
+        # (bucket, 2p-1, 32): the whole forest in one transfer
+        forest = np.asarray(build_forest(jnp.asarray(shards)))
+        p = _next_pow2(n)
+        levels = []
+        off, width = 0, p
+        while width >= 1:
+            levels.append(forest[:, off : off + width])
+            off += width
+            width //= 2
         return [
             MerkleTree([lvl[i] for lvl in levels], n_leaves=n)
             for i in range(b)

@@ -157,10 +157,10 @@ _NODE_PREFIX_BYTE = 0x01
 def build_forest(shards: jnp.ndarray):
     """Build B Merkle trees in ONE XLA program.
 
-    shards (B, n, L) uint8 -> tuple of levels, levels[0] = (B, p, 32)
-    padded leaf digests up to levels[-1] = (B, 1, 32) roots, with
-    p = next power of two >= n.  Leaf digest = SHA256(0x00 || shard),
-    node = SHA256(0x01 || left || right) (ops.merkle convention).
+    shards (B, n, L) uint8 -> (B, 2p-1, 32): all levels concatenated,
+    leaf row first (width p = next power of two >= n), root digest
+    last.  Leaf digest = SHA256(0x00 || shard), node =
+    SHA256(0x01 || left || right) (ops.merkle convention).
     """
     b, n, l = shards.shape
     leaf_msgs = jnp.concatenate(
@@ -196,7 +196,10 @@ def build_forest(shards: jnp.ndarray):
         cur = sha256_batch(msgs).reshape(b, half, 32)
         levels.append(cur)
         width = half
-    return tuple(levels)
+    # single (B, 2p-1, 32) output: ONE device->host transfer for the
+    # whole forest instead of one per level (dispatch/transfer latency
+    # dominates under remote-relay TPU attachment)
+    return jnp.concatenate(levels, axis=1)
 
 
 @jax.jit

@@ -80,8 +80,8 @@ def test_grpc_bidirectional_stream():
 
     def on_conn(conn):
         conn.handle(handler)
-        conn.start()
-        server_conns.append(conn)
+        server_conns.append(conn)  # before start(): the reader thread
+        conn.start()               # may dispatch immediately
 
     server.on_conn(on_conn)
     server.listen()

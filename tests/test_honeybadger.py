@@ -20,9 +20,15 @@ from cleisthenes_tpu.transport.channel import ChannelNetwork
 
 
 def make_hb_network(
-    n, batch_size=16, seed=None, auth=True, auto_propose=True, key_seed=33
+    n,
+    batch_size=16,
+    seed=None,
+    auth=True,
+    auto_propose=True,
+    key_seed=33,
+    crypto_backend="cpu",
 ):
-    cfg = Config(n=n, batch_size=batch_size)
+    cfg = Config(n=n, batch_size=batch_size, crypto_backend=crypto_backend)
     ids = [f"node{i}" for i in range(n)]
     keys = setup_keys(cfg, ids, seed=key_seed)
     net = ChannelNetwork(seed=seed)
@@ -219,3 +225,16 @@ def test_hbbft_epoch_progression_and_queue_decrease():
     after = sum(hb.pending_tx_count() for hb in nodes.values())
     assert after < before
     assert all(hb.epoch >= 1 for hb in nodes.values())
+
+
+def test_hbbft_epoch_on_tpu_backend():
+    """Full consensus with the XLA crypto plane (runs on the CPU
+    backend's XLA in tests; same code path as real TPU)."""
+    cfg, net, nodes = make_hb_network(
+        4, batch_size=8, key_seed=44, crypto_backend="tpu"
+    )
+    push_txs(nodes, 8, prefix=b"xla")
+    for hb in nodes.values():
+        hb.start_epoch()
+    net.run()
+    assert_identical_batches(nodes)
