@@ -1,0 +1,154 @@
+"""Durable committed-batch log: crash/restart recovery.
+
+SURVEY.md §5.4: the reference keeps everything in memory (its only
+resume-adjacent mechanism is the future-epoch buffer) and the build
+plan calls for "an optional committed-batch log for restart".  This is
+that log: an append-only file of (epoch, Batch) records with per-record
+CRCs, replayed at startup to restore the committed history, the epoch
+counter, and the duplicate-filter — so a restarted validator rejoins at
+the epoch after its last commit instead of epoch 0.
+
+Record format (all big-endian, following transport.message's TLV
+style):  magic "CLOG" | u32 record_len | u64 epoch | u32 n_proposers |
+per proposer (u32 id_len | id | u32 n_txs | per tx (u32 len | bytes))
+| u32 crc32(record body).  A torn tail (crash mid-append) is detected
+by length/CRC and truncated away on open.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+import zlib
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from cleisthenes_tpu.core.batch import Batch
+
+_MAGIC = b"CLOG"
+
+
+def _encode_record(epoch: int, batch: Batch) -> bytes:
+    out: List[bytes] = [struct.pack(">Q", epoch)]
+    contributions = batch.contributions
+    out.append(struct.pack(">I", len(contributions)))
+    for proposer in sorted(contributions):
+        pid = proposer.encode("utf-8")
+        out.append(struct.pack(">I", len(pid)))
+        out.append(pid)
+        txs = contributions[proposer]
+        out.append(struct.pack(">I", len(txs)))
+        for tx in txs:
+            out.append(struct.pack(">I", len(tx)))
+            out.append(tx)
+    body = b"".join(out)
+    return (
+        _MAGIC
+        + struct.pack(">I", len(body))
+        + body
+        + struct.pack(">I", zlib.crc32(body))
+    )
+
+
+def _decode_body(body: bytes) -> Tuple[int, Batch]:
+    off = 0
+
+    def u32() -> int:
+        nonlocal off
+        (v,) = struct.unpack_from(">I", body, off)
+        off += 4
+        return v
+
+    (epoch,) = struct.unpack_from(">Q", body, off)
+    off += 8
+    contributions: Dict[str, List[bytes]] = {}
+    for _ in range(u32()):
+        id_len = u32()
+        proposer = body[off : off + id_len].decode("utf-8")
+        off += id_len
+        txs: List[bytes] = []
+        for _ in range(u32()):
+            tx_len = u32()
+            txs.append(body[off : off + tx_len])
+            off += tx_len
+        contributions[proposer] = txs
+    if off != len(body):
+        raise ValueError("trailing bytes in ledger record")
+    return epoch, Batch(contributions=contributions)
+
+
+class BatchLog:
+    """Append-only durable log of committed batches."""
+
+    def __init__(self, path: str, fsync: bool = False):
+        self.path = path
+        self.fsync = fsync
+        self._lock = threading.Lock()
+        self._last_epoch: Optional[int] = None
+        self._recover()
+        self._fh = open(path, "ab")
+
+    def _recover(self) -> None:
+        """Scan the log, truncating any torn tail."""
+        if not os.path.exists(self.path):
+            return
+        good_end = 0
+        with open(self.path, "rb") as fh:
+            data = fh.read()
+        off = 0
+        while off + 8 <= len(data):
+            if data[off : off + 4] != _MAGIC:
+                break
+            (body_len,) = struct.unpack_from(">I", data, off + 4)
+            end = off + 8 + body_len + 4
+            if end > len(data):
+                break
+            body = data[off + 8 : off + 8 + body_len]
+            (crc,) = struct.unpack_from(">I", data, off + 8 + body_len)
+            if zlib.crc32(body) != crc:
+                break
+            try:
+                epoch, _ = _decode_body(body)
+            except (ValueError, struct.error, UnicodeDecodeError):
+                break
+            self._last_epoch = epoch
+            off = end
+            good_end = end
+        if good_end < len(data):  # torn/corrupt tail: drop it
+            with open(self.path, "r+b") as fh:
+                fh.truncate(good_end)
+
+    def append(self, epoch: int, batch: Batch) -> None:
+        rec = _encode_record(epoch, batch)
+        with self._lock:
+            self._fh.write(rec)
+            self._fh.flush()
+            if self.fsync:
+                os.fsync(self._fh.fileno())
+            self._last_epoch = epoch
+
+    def replay(self) -> Iterator[Tuple[int, Batch]]:
+        """All committed (epoch, batch) records, oldest first."""
+        with open(self.path, "rb") as fh:
+            data = fh.read()
+        off = 0
+        while off + 8 <= len(data):
+            if data[off : off + 4] != _MAGIC:
+                return
+            (body_len,) = struct.unpack_from(">I", data, off + 4)
+            end = off + 8 + body_len + 4
+            if end > len(data):
+                return
+            yield _decode_body(data[off + 8 : off + 8 + body_len])
+            off = end
+
+    @property
+    def last_epoch(self) -> Optional[int]:
+        return self._last_epoch
+
+    def close(self) -> None:
+        with self._lock:
+            self._fh.close()
+
+
+__all__ = ["BatchLog"]

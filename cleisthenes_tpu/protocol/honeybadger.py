@@ -238,6 +238,7 @@ class HoneyBadger:
         keys: NodeKeys,
         out,
         auto_propose: bool = True,
+        batch_log=None,
     ) -> None:
         self.config = config
         self.node_id = node_id
@@ -271,6 +272,22 @@ class HoneyBadger:
         # (bounded: one entry per remembered epoch)
         self._committed_filter: Set[bytes] = set()
         self._committed_history: List[Set[bytes]] = []
+        # durable committed-batch log (core.ledger.BatchLog): restore
+        # the committed history + epoch counter + dup-filter on restart
+        self.batch_log = batch_log
+        if batch_log is not None and batch_log.last_epoch is not None:
+            for epoch, batch in batch_log.replay():
+                self.committed_batches.append(batch)
+                self._remember_committed(set(batch.tx_list()))
+            self.epoch = batch_log.last_epoch + 1
+
+    def _remember_committed(self, seen: Set[bytes]) -> None:
+        """Fold one epoch's committed txs into the bounded duplicate
+        filter (shared by live commits and restart replay)."""
+        self._committed_history.append(seen)
+        self._committed_filter |= seen
+        while len(self._committed_history) > COMMITTED_MEMORY_EPOCHS:
+            self._committed_filter -= self._committed_history.pop(0)
 
     # -- public API (reference honeybadger.go:36-59) -----------------------
 
@@ -474,6 +491,8 @@ class HoneyBadger:
         batch = Batch(contributions=contributions)
         self.committed_batches.append(batch)
         self.metrics.epoch_committed(epoch, len(batch))
+        if self.batch_log is not None:
+            self.batch_log.append(epoch, batch)
         # re-queue our own txs that did not make it into the set
         if es.proposed:
             for tx in es.my_txs:
@@ -481,10 +500,7 @@ class HoneyBadger:
                     self.que.push(tx)
         # remember what committed so duplicate local submissions are
         # dropped lazily at poll time (bounded memory)
-        self._committed_history.append(seen)
-        self._committed_filter |= seen
-        while len(self._committed_history) > COMMITTED_MEMORY_EPOCHS:
-            self._committed_filter -= self._committed_history.pop(0)
+        self._remember_committed(seen)
         if self.on_commit is not None:
             self.on_commit(epoch, batch)
         self._advance_epoch()

@@ -190,6 +190,7 @@ class ValidatorHost:
         keys: NodeKeys,
         listen_addr: str = "127.0.0.1:0",
         auto_propose: bool = True,
+        batch_log_path: Optional[str] = None,
     ) -> None:
         self.config = config
         self.node_id = node_id
@@ -210,6 +211,11 @@ class ValidatorHost:
         self.out = GrpcPayloadBroadcaster(
             node_id, self.pool, self.dispatcher, self._auth
         )
+        batch_log = None
+        if batch_log_path is not None:
+            from cleisthenes_tpu.core.ledger import BatchLog
+
+            batch_log = BatchLog(batch_log_path)
         self.node = HoneyBadger(
             config=config,
             node_id=node_id,
@@ -217,6 +223,7 @@ class ValidatorHost:
             keys=keys,
             out=self.out,
             auto_propose=auto_propose,
+            batch_log=batch_log,
         )
         self.dispatcher.bind(self.node)
         self._commits: "queue.Queue" = queue.Queue()
@@ -318,6 +325,8 @@ class ValidatorHost:
         self.server.stop()
         self._client.close()
         self.dispatcher.stop()
+        if self.node.batch_log is not None:
+            self.node.batch_log.close()
 
     # -- application API ---------------------------------------------------
 
