@@ -28,7 +28,19 @@ from cleisthenes_tpu.core.batch import Batch
 _MAGIC = b"CLOG"
 
 
-def _encode_record(epoch: int, batch: Batch) -> bytes:
+def encode_batch_body(epoch: int, batch: Batch) -> bytes:
+    """The CRC-covered record body: (epoch, contributions).  Also the
+    payload of state-sync responses (transport.message
+    SyncResponsePayload), so a synced batch round-trips through the
+    exact bytes a local commit would have logged."""
+    return _encode_body(epoch, batch)
+
+
+def decode_batch_body(body: bytes) -> Tuple[int, Batch]:
+    return _decode_body(body)
+
+
+def _encode_body(epoch: int, batch: Batch) -> bytes:
     out: List[bytes] = [struct.pack(">Q", epoch)]
     contributions = batch.contributions
     out.append(struct.pack(">I", len(contributions)))
@@ -41,7 +53,11 @@ def _encode_record(epoch: int, batch: Batch) -> bytes:
         for tx in txs:
             out.append(struct.pack(">I", len(tx)))
             out.append(tx)
-    body = b"".join(out)
+    return b"".join(out)
+
+
+def _encode_record(epoch: int, batch: Batch) -> bytes:
+    body = _encode_body(epoch, batch)
     return (
         _MAGIC
         + struct.pack(">I", len(body))
@@ -88,31 +104,40 @@ class BatchLog:
         self._recover()
         self._fh = open(path, "ab")
 
+    @staticmethod
+    def _scan(data: bytes) -> Iterator[Tuple[int, bytes]]:
+        """Walk validated records: yields (end_offset, body) for every
+        record whose framing, CRC and body parse check out, stopping
+        at the first torn/corrupt one.  The single source of framing
+        truth for both recovery and replay."""
+        off = 0
+        while off + 8 <= len(data):
+            if data[off : off + 4] != _MAGIC:
+                return
+            (body_len,) = struct.unpack_from(">I", data, off + 4)
+            end = off + 8 + body_len + 4
+            if end > len(data):
+                return
+            body = data[off + 8 : off + 8 + body_len]
+            (crc,) = struct.unpack_from(">I", data, off + 8 + body_len)
+            if zlib.crc32(body) != crc:
+                return
+            try:
+                _decode_body(body)
+            except (ValueError, struct.error, UnicodeDecodeError):
+                return
+            yield end, body
+            off = end
+
     def _recover(self) -> None:
         """Scan the log, truncating any torn tail."""
         if not os.path.exists(self.path):
             return
-        good_end = 0
         with open(self.path, "rb") as fh:
             data = fh.read()
-        off = 0
-        while off + 8 <= len(data):
-            if data[off : off + 4] != _MAGIC:
-                break
-            (body_len,) = struct.unpack_from(">I", data, off + 4)
-            end = off + 8 + body_len + 4
-            if end > len(data):
-                break
-            body = data[off + 8 : off + 8 + body_len]
-            (crc,) = struct.unpack_from(">I", data, off + 8 + body_len)
-            if zlib.crc32(body) != crc:
-                break
-            try:
-                epoch, _ = _decode_body(body)
-            except (ValueError, struct.error, UnicodeDecodeError):
-                break
-            self._last_epoch = epoch
-            off = end
+        good_end = 0
+        for end, body in self._scan(data):
+            self._last_epoch, _ = _decode_body(body)
             good_end = end
         if good_end < len(data):  # torn/corrupt tail: drop it
             with open(self.path, "r+b") as fh:
@@ -131,16 +156,8 @@ class BatchLog:
         """All committed (epoch, batch) records, oldest first."""
         with open(self.path, "rb") as fh:
             data = fh.read()
-        off = 0
-        while off + 8 <= len(data):
-            if data[off : off + 4] != _MAGIC:
-                return
-            (body_len,) = struct.unpack_from(">I", data, off + 4)
-            end = off + 8 + body_len + 4
-            if end > len(data):
-                return
-            yield _decode_body(data[off + 8 : off + 8 + body_len])
-            off = end
+        for _end, body in self._scan(data):
+            yield _decode_body(body)
 
     @property
     def last_epoch(self) -> Optional[int]:
@@ -151,4 +168,4 @@ class BatchLog:
             self._fh.close()
 
 
-__all__ = ["BatchLog"]
+__all__ = ["BatchLog", "encode_batch_body", "decode_batch_body"]

@@ -12,13 +12,16 @@ committed epoch plus the node-0 metrics snapshot.
 from __future__ import annotations
 
 import argparse
+import logging
 import os
+import queue
 import threading
 import time
 
 from cleisthenes_tpu.config import Config
 from cleisthenes_tpu.protocol.honeybadger import setup_keys
 from cleisthenes_tpu.transport.host import ValidatorHost
+from cleisthenes_tpu.utils.log import configure as configure_logging
 
 
 def main(argv=None) -> int:
@@ -35,7 +38,11 @@ def main(argv=None) -> int:
         default=None,
         help="directory for durable committed-batch logs (restart demo)",
     )
+    ap.add_argument(
+        "--verbose", action="store_true", help="debug-level node logs"
+    )
     args = ap.parse_args(argv)
+    configure_logging(logging.DEBUG if args.verbose else logging.INFO)
 
     cfg = Config(
         n=args.n, batch_size=args.batch_size, crypto_backend=args.crypto
@@ -77,7 +84,7 @@ def main(argv=None) -> int:
     # run-unique prefix: with --log-dir, a restarted demo's txs must
     # not collide with the previous run's (already-committed names are
     # dup-filtered by design)
-    prefix = b"demo-%d" % int(time.time())
+    prefix = b"demo-%d" % time.time_ns()
     txs = [b"%s-tx-%05d" % (prefix, i) for i in range(args.txs)]
     for i, tx in enumerate(txs):
         hosts[ids[i % args.n]].submit(tx)
@@ -90,7 +97,7 @@ def main(argv=None) -> int:
             h.propose()
         try:
             epoch, batch = watcher.wait_commit(timeout=2.0)
-        except Exception:
+        except queue.Empty:
             continue
         batch_txs = batch.tx_list()
         committed |= set(batch_txs) & set(txs)

@@ -47,6 +47,7 @@ from cleisthenes_tpu.ops.tpke import (
     Tpke,
 )
 from cleisthenes_tpu.protocol.acs import ACS
+from cleisthenes_tpu.utils.log import NodeLogger
 from cleisthenes_tpu.utils.metrics import Metrics
 from cleisthenes_tpu.transport.message import (
     BbaPayload,
@@ -54,6 +55,8 @@ from cleisthenes_tpu.transport.message import (
     DecSharePayload,
     Message,
     RbcPayload,
+    SyncRequestPayload,
+    SyncResponsePayload,
 )
 
 # Sliding epoch window: how many settled epochs stay responsive for
@@ -259,6 +262,7 @@ class HoneyBadger:
         self.committed_batches: List[Batch] = []
         self.on_commit: Optional[Callable[[int, Batch], None]] = None
         self.metrics = Metrics()
+        self.log = NodeLogger(node_id, "hb")
         self.out = _CountingBroadcaster(out, self.metrics, len(self.members))
         self._epochs: Dict[int, _EpochState] = {}
         # production: unpredictable sampling (censorship resistance);
@@ -280,6 +284,10 @@ class HoneyBadger:
                 self.committed_batches.append(batch)
                 self._remember_committed(set(batch.tx_list()))
             self.epoch = batch_log.last_epoch + 1
+        # state-sync: epoch -> sender -> response body (f+1 identical
+        # bodies for the NEXT epoch let a laggard adopt it directly)
+        self._sync_responses: Dict[str, bytes] = {}
+        self._last_sync_request: Optional[int] = None
 
     def _remember_committed(self, seen: Set[bytes]) -> None:
         """Fold one epoch's committed txs into the bounded duplicate
@@ -353,8 +361,19 @@ class HoneyBadger:
         if epoch is None:
             return
         self.metrics.msgs_in.inc()
+        # state-sync traffic is deliberately NOT epoch-window gated:
+        # it exists exactly for nodes outside the window
+        if isinstance(payload, SyncRequestPayload):
+            self._handle_sync_request(msg.sender_id, payload)
+            return
+        if isinstance(payload, SyncResponsePayload):
+            self._handle_sync_response(msg.sender_id, payload)
+            return
         es = self._epoch_state(epoch)
         if es is None:  # outside the sliding window
+            if epoch > self.epoch + EPOCH_HORIZON:
+                # peers are far ahead: we missed epochs, catch up
+                self._request_sync()
             return
         if isinstance(payload, DecSharePayload):
             self._handle_dec_share(es, msg.sender_id, payload)
@@ -467,6 +486,81 @@ class HoneyBadger:
             # a failed tag/framing fails identically at every node
             es.decrypted[proposer] = None
 
+    # -- state sync (crash-recovery catch-up; SURVEY.md §5.3-5.4) ----------
+
+    def request_sync(self) -> None:
+        """Ask the roster for the committed batch of our current epoch
+        (call after a restart; also fired automatically when peer
+        traffic shows we are more than EPOCH_HORIZON behind)."""
+        self._request_sync(force=True)
+
+    def _request_sync(self, force: bool = False) -> None:
+        if not force and self._last_sync_request == self.epoch:
+            return  # one request per epoch value (re-fired as we adopt)
+        self._last_sync_request = self.epoch
+        self.out.broadcast(SyncRequestPayload(epoch=self.epoch))
+
+    def _handle_sync_request(
+        self, sender: str, p: SyncRequestPayload
+    ) -> None:
+        if sender not in self.members:
+            return
+        if not (0 <= p.epoch < len(self.committed_batches)):
+            return  # we don't have it (or it doesn't exist yet)
+        from cleisthenes_tpu.core.ledger import encode_batch_body
+
+        self.out.send_to(
+            sender,
+            SyncResponsePayload(
+                epoch=p.epoch,
+                body=encode_batch_body(
+                    p.epoch, self.committed_batches[p.epoch]
+                ),
+            ),
+        )
+
+    def _handle_sync_response(
+        self, sender: str, p: SyncResponsePayload
+    ) -> None:
+        if sender not in self.members or p.epoch != self.epoch:
+            return
+        self._sync_responses[sender] = p.body
+        # f+1 identical bodies include at least one honest node, so
+        # the body is the true committed batch for this epoch
+        counts: Dict[bytes, int] = {}
+        for body in self._sync_responses.values():
+            counts[body] = counts.get(body, 0) + 1
+        body, votes = max(counts.items(), key=lambda kv: kv[1])
+        if votes < self.config.f + 1:
+            return
+        from cleisthenes_tpu.core.ledger import decode_batch_body
+
+        try:
+            epoch, batch = decode_batch_body(body)
+        except (ValueError, struct.error, UnicodeDecodeError):
+            return
+        if epoch != self.epoch:
+            return
+        self._adopt_synced_batch(epoch, batch)
+
+    def _adopt_synced_batch(self, epoch: int, batch: Batch) -> None:
+        """Commit a batch learned via state sync instead of running the
+        (long-gone) epoch ourselves."""
+        self.log.info("adopted synced batch", epoch=epoch, txs=len(batch))
+        self.committed_batches.append(batch)
+        seen = set(batch.tx_list())
+        self._remember_committed(seen)
+        self.metrics.epoch_committed(epoch, len(batch))
+        if self.batch_log is not None:
+            self.batch_log.append(epoch, batch)
+        self._epochs.pop(epoch, None)  # any partial local state is moot
+        self._sync_responses.clear()
+        if self.on_commit is not None:
+            self.on_commit(epoch, batch)
+        self._advance_epoch()
+        # still behind? chase the next epoch immediately
+        self._request_sync(force=True)
+
     # -- commit (the consensused batch of honeybadger.go:20-21) ------------
 
     def _maybe_commit(self, epoch: int, es: _EpochState) -> None:
@@ -493,6 +587,7 @@ class HoneyBadger:
         self.metrics.epoch_committed(epoch, len(batch))
         if self.batch_log is not None:
             self.batch_log.append(epoch, batch)
+        self.log.debug("committed", epoch=epoch, txs=len(batch))
         # re-queue our own txs that did not make it into the set
         if es.proposed:
             for tx in es.my_txs:
@@ -507,6 +602,7 @@ class HoneyBadger:
 
     def _advance_epoch(self) -> None:
         self.epoch += 1
+        self._sync_responses.clear()  # responses are per-epoch votes
         for stale in [
             e for e in self._epochs if e < self.epoch - KEEP_BEHIND
         ]:

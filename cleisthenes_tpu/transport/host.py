@@ -38,6 +38,7 @@ from cleisthenes_tpu.transport.grpc_net import (
     GrpcServer,
 )
 from cleisthenes_tpu.transport.message import Message, Payload
+from cleisthenes_tpu.utils.log import NodeLogger
 
 
 class SerialDispatcher:
@@ -198,6 +199,7 @@ class ValidatorHost:
         self.keys = keys
         self._addrs: Dict[str, str] = {}
         self._stopping = threading.Event()
+        self.log = NodeLogger(node_id, "host")
         self._auth = HmacAuthenticator(keys.mac_master, node_id)
         # inbound verification is sender-keyed, so one authenticator
         # verifies all peers; signing is bound to node_id
@@ -241,7 +243,9 @@ class ValidatorHost:
 
     def listen(self) -> str:
         self.server.listen()
-        return f"127.0.0.1:{self.server.port}"
+        addr = f"127.0.0.1:{self.server.port}"
+        self.log.info("listening", addr=addr)
+        return addr
 
     def connect(
         self, addrs: Dict[str, str], deadline_s: float = 10.0
@@ -261,6 +265,11 @@ class ValidatorHost:
                 member, lambda: time.monotonic() - t0 > deadline_s
             )
         self.out.mark_ready()
+        self.log.info("connected", peers=len(self.pool))
+        if self.node.epoch > 0:
+            # restarted from a durable log: peers may have committed
+            # epochs we missed — catch up before proposing
+            self.dispatcher.call(self.node.request_sync)
 
     def _dial_member(self, member: str, expired, retry_s: float = 0.05):
         """Dial one member; retries at ``retry_s`` until ``expired``.
@@ -298,6 +307,7 @@ class ValidatorHost:
 
     def _on_conn_lost(self, member: str, conn) -> None:
         self.pool.remove(member)
+        self.log.warning("peer stream lost", peer=member)
         if self._stopping.is_set():
             return
         threading.Thread(

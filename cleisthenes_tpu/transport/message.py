@@ -128,7 +128,33 @@ class DecSharePayload:
     z: int
 
 
-Payload = Union[RbcPayload, BbaPayload, CoinPayload, DecSharePayload]
+@dataclasses.dataclass(frozen=True)
+class SyncRequestPayload:
+    """Catch-up request from a lagging/restarted node: "send me the
+    committed batch of ``epoch``" (the state-sync step HBBFT itself
+    does not define; SURVEY.md §5.3-5.4 recovery story)."""
+
+    epoch: int
+
+
+@dataclasses.dataclass(frozen=True)
+class SyncResponsePayload:
+    """One peer's committed batch for ``epoch`` (ledger body bytes).
+    A node adopts it only after f+1 distinct senders agree — at least
+    one of them is honest, so the batch is the true committed one."""
+
+    epoch: int
+    body: bytes
+
+
+Payload = Union[
+    RbcPayload,
+    BbaPayload,
+    CoinPayload,
+    DecSharePayload,
+    SyncRequestPayload,
+    SyncResponsePayload,
+]
 
 # oneof discriminants (reference message.proto:18-22 has rbc=3, bba=4;
 # we keep those two numbers and extend)
@@ -136,6 +162,8 @@ _KIND_RBC = 3
 _KIND_BBA = 4
 _KIND_COIN = 5
 _KIND_DEC = 6
+_KIND_SYNC_REQ = 7
+_KIND_SYNC_RESP = 8
 
 
 @dataclasses.dataclass(frozen=True)
@@ -266,6 +294,13 @@ def _encode_payload(p: Payload) -> Tuple[int, bytes]:
         _pack_int(out, p.e)
         _pack_int(out, p.z)
         return _KIND_DEC, b"".join(out)
+    if isinstance(p, SyncRequestPayload):
+        out.append(struct.pack(">Q", p.epoch))
+        return _KIND_SYNC_REQ, b"".join(out)
+    if isinstance(p, SyncResponsePayload):
+        out.append(struct.pack(">Q", p.epoch))
+        _pack_bytes(out, p.body)
+        return _KIND_SYNC_RESP, b"".join(out)
     raise TypeError(f"unknown payload type {type(p)!r}")
 
 
@@ -321,6 +356,10 @@ def _decode_payload_inner(r: _Reader, kind: int) -> Payload:
             proposer=proposer, epoch=epoch, index=idx,
             d=r.int_(), e=r.int_(), z=r.int_(),
         )
+    if kind == _KIND_SYNC_REQ:
+        return SyncRequestPayload(epoch=r.u64())
+    if kind == _KIND_SYNC_RESP:
+        return SyncResponsePayload(epoch=r.u64(), body=r.bytes_())
     raise ValueError(f"unknown payload kind {kind}")
 
 
@@ -370,6 +409,8 @@ __all__ = [
     "BbaPayload",
     "CoinPayload",
     "DecSharePayload",
+    "SyncRequestPayload",
+    "SyncResponsePayload",
     "RbcType",
     "BbaType",
     "encode_message",

@@ -238,3 +238,21 @@ def test_hbbft_epoch_on_tpu_backend():
         hb.start_epoch()
     net.run()
     assert_identical_batches(nodes)
+
+
+def test_hbbft_scale_n16():
+    """BASELINE config 2 shape (N=16, f=5) in-proc: one full epoch,
+    64 txs, identical batches on all 16 validators."""
+    cfg, net, nodes = make_hb_network(16, batch_size=64, seed=4)
+    assert cfg.f == 5 and cfg.data_shards == 6
+    txs = push_txs(nodes, 64)
+    for hb in nodes.values():
+        hb.start_epoch()
+    net.run()
+    depth = assert_identical_batches(nodes)
+    committed = {
+        tx
+        for b in nodes["node0"].committed_batches[:depth]
+        for tx in b.tx_list()
+    }
+    assert committed == set(txs)
