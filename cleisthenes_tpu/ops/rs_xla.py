@@ -70,6 +70,10 @@ def _decode_kernel(g_bits: jnp.ndarray, shards: jnp.ndarray) -> jnp.ndarray:
 # dimension — all N RBC instances' codec work in a single dispatch.
 _encode_kernel_batch = jax.jit(jax.vmap(_encode_kernel, in_axes=(None, 0)))
 _decode_kernel_batch = jax.jit(jax.vmap(_decode_kernel, in_axes=(0, 0)))
+# Shared-erasure-pattern decode: every instance lost the same shards
+# (the common case — e.g. the same f laggards across all N RBCs), so
+# one small matrix ships instead of a per-instance stack.
+_decode_kernel_shared = jax.jit(jax.vmap(_decode_kernel, in_axes=(None, 0)))
 
 
 class XlaErasureCoder(ErasureCoder):
@@ -112,12 +116,14 @@ class XlaErasureCoder(ErasureCoder):
         self, indices: np.ndarray, shards: np.ndarray
     ) -> np.ndarray:
         shards = np.ascontiguousarray(shards, dtype=np.uint8)
-        g = jnp.stack(
-            [
-                self._decode_bits(self._normalize_indices(ix))
-                for ix in indices
-            ]
-        )
+        patterns = [self._normalize_indices(ix) for ix in indices]
+        if len(set(patterns)) == 1:
+            return np.asarray(
+                _decode_kernel_shared(
+                    self._decode_bits(patterns[0]), jnp.asarray(shards)
+                )
+            )
+        g = jnp.stack([self._decode_bits(p) for p in patterns])
         return np.asarray(_decode_kernel_batch(g, jnp.asarray(shards)))
 
 
