@@ -21,7 +21,10 @@ path; the north-star target is the whole epoch under 1000 ms.
 """
 
 import json
+import os
 import statistics
+import subprocess
+import sys
 import time
 
 import numpy as np
@@ -119,7 +122,12 @@ def measure(backend: str) -> float:
     return statistics.median(times)
 
 
-def main() -> None:
+def run_child() -> None:
+    """The actual measurement; prints the JSON result line.
+
+    Runs in a subprocess so a hung TPU relay (which cannot be
+    interrupted in-process) is bounded by the parent's timeout.
+    """
     # the accelerated path under test ('tpu' = XLA on whatever device
     # is present; on a CPU-only host it still exercises the XLA path)
     accel_p50 = measure("tpu")
@@ -137,5 +145,104 @@ def main() -> None:
     )
 
 
+CHILD_TIMEOUT_S = int(os.environ.get("BENCH_CHILD_TIMEOUT_S", "900"))
+
+
+def _spawn_child(force_cpu: bool) -> "tuple[dict | None, str]":
+    """Run the measurement subprocess; return (parsed JSON, detail)."""
+    env = dict(os.environ)
+    if force_cpu:
+        # skip the axon PJRT plugin registration entirely so the dead
+        # relay is never touched; the XLA path then runs on host CPU
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child"],
+            timeout=CHILD_TIMEOUT_S,
+            capture_output=True,
+            text=True,
+            env=env,
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"timeout after {CHILD_TIMEOUT_S}s"
+    for line in reversed(r.stdout.strip().splitlines()):
+        try:
+            parsed = json.loads(line)
+            if isinstance(parsed, dict) and "metric" in parsed:
+                return parsed, ""
+        except json.JSONDecodeError:
+            continue
+    tail = (r.stderr or r.stdout or "").strip().splitlines()
+    return None, f"rc={r.returncode}: {' | '.join(tail[-3:]) or 'no output'}"
+
+
+def _probe_relay(timeout_s: int = 90) -> bool:
+    """Cheap subprocess probe: can the default backend run one op?
+
+    A dead axon relay hangs indefinitely on first dispatch, so the
+    probe (not the full 15-min measurement) is what bounds the cost of
+    discovering an outage.
+    """
+    code = (
+        "import jax, jax.numpy as jnp, numpy as np;"
+        "print('PROBE_OK' if float(np.asarray(jnp.ones(8).sum())) == 8.0"
+        " else 'PROBE_BAD')"
+    )
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code],
+            timeout=timeout_s,
+            capture_output=True,
+            text=True,
+        )
+    except subprocess.TimeoutExpired:
+        return False
+    return r.returncode == 0 and "PROBE_OK" in r.stdout
+
+
+def main() -> None:
+    """Driver entry: bounded retry on the TPU relay, CPU-XLA fallback,
+    and ALWAYS one parseable JSON line on stdout (never a bare
+    traceback — the round-1 failure mode, BENCH_r01.json rc=1)."""
+    errors = []
+    healthy = False
+    for attempt in range(2):
+        if _probe_relay():
+            healthy = True
+            break
+        errors.append(f"probe {attempt + 1}: relay unreachable")
+        time.sleep(5)
+    if healthy:
+        result, detail = _spawn_child(force_cpu=False)
+        if result is not None:
+            print(json.dumps(result))
+            return
+        errors.append(f"tpu run: {detail}")
+    result, detail = _spawn_child(force_cpu=True)
+    if result is not None:
+        result["note"] = (
+            "axon TPU relay unavailable; XLA path measured on host CPU "
+            f"({'; '.join(errors)})"
+        )
+        print(json.dumps(result))
+        return
+    errors.append(f"cpu fallback: {detail}")
+    print(
+        json.dumps(
+            {
+                "metric": "epoch_crypto_p50_n64_f21_b10k",
+                "value": None,
+                "unit": "ms",
+                "vs_baseline": None,
+                "error": "; ".join(errors),
+            }
+        )
+    )
+
+
 if __name__ == "__main__":
-    main()
+    if "--child" in sys.argv:
+        run_child()
+    else:
+        main()

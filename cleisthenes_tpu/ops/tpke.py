@@ -50,6 +50,21 @@ def _ibytes(x: int) -> bytes:
     return x.to_bytes(32, "big")
 
 
+def is_group_element(x: int) -> bool:
+    """Strict membership test for the prime-order QR subgroup:
+    ``1 < x < P`` and ``x^Q == 1 (mod P)``.
+
+    Rejects 0, the identity, P-1 (the order-2 element) and every
+    non-residue — the inputs a Byzantine proposer could use to make all
+    honest decryption shares unverifiable forever (each honest node's
+    d_i = c1^{s_i} then fails its own CP proof, burning every honest
+    sender in the SharePool and stalling _maybe_commit), or to leak
+    share parities via the order-2 component.  One ~256-bit modexp on
+    host per check; callers run it once per deserialized ciphertext.
+    """
+    return 1 < x < P and pow(x, Q, P) == 1
+
+
 def hash_to_group(data: bytes) -> int:
     """Map bytes to the QR subgroup with unknown discrete log:
     (H(data) mod p)^2 mod p."""
@@ -374,6 +389,7 @@ class Tpke:
 
 
 __all__ = [
+    "is_group_element",
     "ThresholdPublicKey",
     "ThresholdSecretShare",
     "DhShare",

@@ -117,6 +117,14 @@ def deserialize_ciphertext(data: bytes) -> Ciphertext:
     if len(data) < 36:
         raise ValueError("truncated ciphertext")
     c1 = int.from_bytes(data[:32], "big")
+    if not tpke_mod.is_group_element(c1):
+        # c1 outside the prime-order subgroup (0, identity, order-2,
+        # non-residue) would make every honest node's decryption share
+        # fail verification forever — consensus-halting.  Raising here
+        # routes the proposer into the deterministic-exclusion junk
+        # path every correct node takes identically (ADVICE.md round-1
+        # high finding).
+        raise ValueError("ciphertext c1 not in the prime-order subgroup")
     (ln,) = struct.unpack_from(">I", data, 32)
     if 36 + ln + 32 != len(data):
         raise ValueError("bad ciphertext framing")
@@ -138,7 +146,10 @@ class NodeKeys:
     tpke_share: ThresholdSecretShare
     coin_pub: ThresholdPublicKey
     coin_share: ThresholdSecretShare
-    mac_master: bytes
+    # this node's pairwise MAC keys: peer_id -> k_{self,peer}.  The
+    # dealer's master never leaves setup_keys, so no single member can
+    # reconstruct another pair's key (ADVICE.md round-1 high finding).
+    mac_keys: Dict[str, bytes]
 
 
 def setup_keys(
@@ -168,13 +179,20 @@ def setup_keys(
         mac_master = secrets.token_bytes(32)
     else:
         mac_master = b"cleisthenes-tpu-test-mac|%d" % seed
+    # dealer-side pairwise key schedule: node i receives ONLY the keys
+    # of pairs it belongs to; the master itself is never distributed
+    from cleisthenes_tpu.transport.base import HmacAuthenticator
+
+    mac_key_maps = {
+        m: HmacAuthenticator.key_map(mac_master, m, members) for m in members
+    }
     return {
         m: NodeKeys(
             tpke_pub=tpke_pub,
             tpke_share=tpke_shares[i],
             coin_pub=coin_pub,
             coin_share=coin_shares[i],
-            mac_master=mac_master,
+            mac_keys=mac_key_maps[m],
         )
         for i, m in enumerate(members)
     }

@@ -23,7 +23,11 @@ import hmac
 import threading
 from typing import Callable, Dict, List, Optional, Protocol, runtime_checkable
 
-from cleisthenes_tpu.transport.message import Message, signing_bytes
+from cleisthenes_tpu.transport.message import (
+    Message,
+    attach_signature,
+    signing_bytes,
+)
 
 
 @runtime_checkable
@@ -69,21 +73,40 @@ class Broadcaster(Protocol):
 
 
 class Authenticator(abc.ABC):
-    """Signs and verifies envelope MACs."""
+    """Signs and verifies envelope MACs.
+
+    ``sign`` takes the intended receiver because MAC keys are scoped to
+    the (sender, receiver) pair — a broadcast is N individually-MACed
+    frames, not one frame fanned out.
+    """
 
     @abc.abstractmethod
-    def sign(self, msg: Message) -> Message:
+    def sign(self, msg: Message, receiver_id: Optional[str] = None) -> Message:
         """Return a copy of ``msg`` with the signature field filled."""
 
     @abc.abstractmethod
     def verify(self, msg: Message) -> bool: ...
+
+    def sign_wire_many(self, msg: Message, receiver_ids) -> "Dict[str, bytes]":
+        """receiver_id -> complete wire frame, for broadcasts.
+
+        Default: sign+encode per receiver.  Pairwise-MAC backends
+        override to encode the envelope once and append per-receiver
+        MACs (the broadcast hot path is N frames that differ only in
+        the 32-byte signature).
+        """
+        from cleisthenes_tpu.transport.message import encode_message
+
+        return {
+            rid: encode_message(self.sign(msg, rid)) for rid in receiver_ids
+        }
 
 
 class NullAuthenticator(Authenticator):
     """Reference-faithful stand-in: accept everything
     (conn.go:134-137 behavior, for benchmarks isolating crypto cost)."""
 
-    def sign(self, msg: Message) -> Message:
+    def sign(self, msg: Message, receiver_id: Optional[str] = None) -> Message:
         return msg
 
     def verify(self, msg: Message) -> bool:
@@ -91,35 +114,76 @@ class NullAuthenticator(Authenticator):
 
 
 class HmacAuthenticator(Authenticator):
-    """HMAC-SHA256 over the envelope with per-sender derived keys.
+    """HMAC-SHA256 over the envelope with per-ordered-pair keys.
 
-    Key for sender i is HKDF-style ``H(master || sender_id)`` so a MAC
-    authenticates the claimed ``sender_id``, preventing one roster
-    member from impersonating another (the property the reference's
-    empty ``verify`` was meant to provide).  The master secret is part
-    of the trusted-dealer setup alongside the TPKE/coin keys.
+    Node i holds ONLY the pair keys ``k_{i,j}`` for pairs it belongs
+    to: it signs a message to j with ``k_{i,j}`` and verifies an
+    inbound claim "from j" with ``k_{j,i}`` (= ``k_{i,j}``, unordered).
+    Because a third roster member c never holds ``k_{i,j}``, c cannot
+    forge envelopes between honest i and j — which is the quorum-
+    intersection property RBC/BBA/ACS need from the reference's empty
+    ``verify`` TODO (conn.go:134-137).  What a Byzantine j CAN still do
+    is lie to each peer separately (equivocate) — the protocol's
+    Byzantine tolerance, not the MAC layer, covers that.
+
+    The dealer derives pair keys from a master secret it never
+    distributes (``protocol.honeybadger.setup_keys``); each node
+    receives just its own key map.  ``derive`` reproduces the dealer's
+    schedule for tests that hold the master themselves.
     """
 
-    def __init__(self, master_secret: bytes, self_id: str):
-        self._master = master_secret
+    def __init__(self, self_id: str, peer_keys: "Dict[str, bytes]"):
         self._self_id = self_id
+        self._peer_keys = dict(peer_keys)
 
-    def _key_for(self, sender_id: str) -> bytes:
+    @staticmethod
+    def pair_key(master_secret: bytes, a: str, b: str) -> bytes:
+        """The dealer's derivation: unordered-pair key
+        ``H("macpair" || master || min(a,b) || max(a,b))``."""
+        lo, hi = sorted((a.encode("utf-8"), b.encode("utf-8")))
         return hashlib.sha256(
-            b"mac|" + self._master + b"|" + sender_id.encode("utf-8")
+            b"macpair|" + master_secret + b"|" + lo + b"|" + hi
         ).digest()
 
-    def sign(self, msg: Message) -> Message:
+    @classmethod
+    def key_map(
+        cls, master_secret: bytes, self_id: str, roster_ids
+    ) -> "Dict[str, bytes]":
+        """The dealer's key schedule for one node: every pair key
+        ``self_id`` belongs to (the single source both ``derive`` and
+        ``protocol.honeybadger.setup_keys`` use)."""
+        return {
+            peer: cls.pair_key(master_secret, self_id, peer)
+            for peer in roster_ids
+        }
+
+    @classmethod
+    def derive(
+        cls, master_secret: bytes, self_id: str, roster_ids
+    ) -> "HmacAuthenticator":
+        """Build node ``self_id``'s authenticator from the dealer's
+        master (test/dealer-side convenience)."""
+        return cls(self_id, cls.key_map(master_secret, self_id, roster_ids))
+
+    def _key_with(self, peer_id: str) -> Optional[bytes]:
+        return self._peer_keys.get(peer_id)
+
+    def sign(self, msg: Message, receiver_id: Optional[str] = None) -> Message:
         if msg.sender_id != self._self_id:
             # a mismatch would produce messages every receiver silently
-            # rejects (MAC keyed by self_id, verified by sender_id)
+            # rejects (pair key involves self_id, verified by sender_id)
             raise ValueError(
                 f"cannot sign as {msg.sender_id!r}: this authenticator "
-                f"holds the key for {self._self_id!r}"
+                f"holds the keys of {self._self_id!r}"
             )
-        mac = hmac.new(
-            self._key_for(self._self_id), signing_bytes(msg), hashlib.sha256
-        ).digest()
+        if receiver_id is None:
+            raise ValueError(
+                "pairwise MAC needs the receiver id at sign time"
+            )
+        key = self._key_with(receiver_id)
+        if key is None:
+            raise ValueError(f"no pair key with {receiver_id!r}")
+        mac = hmac.new(key, signing_bytes(msg), hashlib.sha256).digest()
         return Message(
             sender_id=msg.sender_id,
             timestamp=msg.timestamp,
@@ -128,10 +192,29 @@ class HmacAuthenticator(Authenticator):
         )
 
     def verify(self, msg: Message) -> bool:
-        want = hmac.new(
-            self._key_for(msg.sender_id), signing_bytes(msg), hashlib.sha256
-        ).digest()
+        key = self._key_with(msg.sender_id)
+        if key is None:  # not a roster member we share a key with
+            return False
+        want = hmac.new(key, signing_bytes(msg), hashlib.sha256).digest()
         return hmac.compare_digest(want, msg.signature)
+
+    def sign_wire_many(self, msg: Message, receiver_ids) -> "Dict[str, bytes]":
+        """Broadcast fast path: one payload encode, one MAC per peer."""
+        if msg.sender_id != self._self_id:
+            raise ValueError(
+                f"cannot sign as {msg.sender_id!r}: this authenticator "
+                f"holds the keys of {self._self_id!r}"
+            )
+        sb = signing_bytes(msg)
+        out: Dict[str, bytes] = {}
+        for rid in receiver_ids:
+            key = self._key_with(rid)
+            if key is None:
+                raise ValueError(f"no pair key with {rid!r}")
+            out[rid] = attach_signature(
+                sb, hmac.new(key, sb, hashlib.sha256).digest()
+            )
+        return out
 
 
 # ---------------------------------------------------------------------------

@@ -165,7 +165,7 @@ class ChannelNetwork:
         if len(self._pending) >= self._queue_capacity:
             raise OverflowError("channel network queue full")
         ep = self._endpoints.get(sender_id)
-        signed = ep.auth.sign(msg) if ep is not None else msg
+        signed = ep.auth.sign(msg, receiver_id) if ep is not None else msg
         wire = encode_message(signed)
         self.messages_posted += 1
         self.bytes_posted += len(wire)

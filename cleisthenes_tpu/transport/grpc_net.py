@@ -94,7 +94,10 @@ class GrpcConnection:
         """conn.go:66-77: enqueue with callbacks; full mailbox or a
         closed connection surfaces through on_err."""
         try:
-            wire = encode_message(self._auth.sign(msg))
+            # the pool addresses client connections by roster member id
+            # (host.py DialOpts conn_id=member), so conn_id names the
+            # receiver for the pairwise MAC
+            wire = encode_message(self._auth.sign(msg, self._conn_id))
         except Exception as exc:
             if on_err is not None:
                 on_err(exc)

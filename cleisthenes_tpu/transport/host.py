@@ -151,12 +151,18 @@ class GrpcPayloadBroadcaster:
 
     def _deliver(self, member_id: Optional[str], msg: Message) -> None:
         """member_id None = broadcast to all peers."""
-        from cleisthenes_tpu.transport.message import encode_message
-
         if member_id is None:
-            wire = encode_message(self._auth.sign(msg))
-            for conn in self._pool.get_all():
-                conn.send_wire(wire)
+            # pairwise MACs: each peer gets its own signed frame (one
+            # key per peer — the sign-once/fan-out-identical-bytes path
+            # would need a key every peer shares, exactly the forgeable
+            # design ADVICE.md retired).  The envelope is encoded once;
+            # only the 32-byte MAC differs per frame.
+            conns = self._pool.get_all()
+            frames = self._auth.sign_wire_many(
+                msg, [c.id() for c in conns]
+            )
+            for conn in conns:
+                conn.send_wire(frames[conn.id()])
         else:
             self._pool.send_to(member_id, msg)
 
@@ -200,9 +206,10 @@ class ValidatorHost:
         self._addrs: Dict[str, str] = {}
         self._stopping = threading.Event()
         self.log = NodeLogger(node_id, "host")
-        self._auth = HmacAuthenticator(keys.mac_master, node_id)
-        # inbound verification is sender-keyed, so one authenticator
-        # verifies all peers; signing is bound to node_id
+        self._auth = HmacAuthenticator(node_id, keys.mac_keys)
+        # inbound verification looks up the pair key by sender id, so
+        # one authenticator verifies all peers; signing is bound to
+        # (node_id, receiver) pairs
         self.dispatcher = SerialDispatcher(name=f"dispatch-{node_id}")
         self.server = GrpcServer(
             listen_addr, self._auth, capacity=config.channel_capacity

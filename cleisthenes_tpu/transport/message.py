@@ -375,10 +375,15 @@ def signing_bytes(msg: Message) -> bytes:
     return b"".join(out)
 
 
+def attach_signature(signing: bytes, signature: bytes) -> bytes:
+    """Complete a frame from its pre-computed signing bytes: the wire
+    layout is ``signing_bytes || len(sig) || sig``, so a broadcast can
+    encode the envelope once and append a per-receiver MAC."""
+    return signing + struct.pack(">I", len(signature)) + signature
+
+
 def encode_message(msg: Message) -> bytes:
-    out = [signing_bytes(msg)]
-    _pack_bytes(out, msg.signature)
-    return b"".join(out)
+    return attach_signature(signing_bytes(msg), msg.signature)
 
 
 def decode_message(data: bytes) -> Message:

@@ -44,7 +44,9 @@ def make_acs_network(n, seed=None, auth=False):
         net.join(
             node_id,
             AcsHandler(acs),
-            HmacAuthenticator(b"acs-master", node_id) if auth else None,
+            HmacAuthenticator.derive(b"acs-master", node_id, ids)
+            if auth
+            else None,
         )
     return cfg, net, acss
 

@@ -48,7 +48,7 @@ def make_bba_network(n, seed=None, auth=False, proposer_idx=0):
         net.join(
             node_id,
             BbaHandler(bba),
-            HmacAuthenticator(b"master", node_id) if auth else None,
+            HmacAuthenticator.derive(b"master", node_id, ids) if auth else None,
         )
     return cfg, net, bbas
 

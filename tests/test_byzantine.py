@@ -103,3 +103,48 @@ def test_byzantine_silent_coalition_liveness():
     run_epochs(net, nodes, skip=coalition)
     depth = assert_identical_batches(nodes, skip=coalition)
     assert depth >= 1
+
+
+def test_byzantine_poisoned_ciphertext_excluded():
+    """ADVICE.md round-1 high finding: a proposer whose RBC'd
+    "ciphertext" carries c1 = P-1 (the order-2 element, outside the
+    prime-order subgroup) used to make every honest node's decryption
+    share fail verification forever, burning all honest senders and
+    halting consensus.  With subgroup validation at deserialization the
+    proposer is deterministically excluded and the epoch commits."""
+    import struct
+
+    from cleisthenes_tpu.ops.modmath import P
+
+    cfg, net, nodes = make_hb_network(4, batch_size=8, seed=17)
+    bad = "node3"
+    c2 = b"\x00" * 16
+    poisoned = (
+        (P - 1).to_bytes(32, "big")
+        + struct.pack(">I", len(c2))
+        + c2
+        + b"\x11" * 32
+    )
+    hb_bad = nodes[bad]
+
+    def poisoned_start():
+        es = hb_bad._epoch_state(hb_bad.epoch)
+        if es is None or es.proposed:
+            return
+        es.proposed = True
+        es.my_txs = []
+        es.acs.input(poisoned)
+
+    hb_bad.start_epoch = poisoned_start
+    # txs go to honest nodes only: anything queued at the poisoned
+    # proposer can never commit, and its non-empty queue would keep
+    # auto-proposing fresh (excluded) epochs forever — a livelock of
+    # the TEST setup, not the protocol
+    push_txs({k: v for k, v in nodes.items() if k != bad}, 12)
+    run_epochs(net, nodes, skip=(bad,))
+    depth = assert_identical_batches(nodes)
+    assert depth >= 1
+    # the poisoned proposal contributed no transactions anywhere
+    for hb in nodes.values():
+        for b in hb.committed_batches:
+            assert all(tx.startswith(b"tx-") for tx in b.tx_list())

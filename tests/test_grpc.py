@@ -106,20 +106,23 @@ def test_grpc_mac_rejects_forged_sender():
     """A frame MAC'd with the wrong key must be dropped (the
     implemented conn.go:134-137)."""
     master = b"grpc-test-master"
+    roster = ["server", "bob", "eve"]
     handler = CollectingHandler()
-    server = GrpcServer("127.0.0.1:0", HmacAuthenticator(master, "server"))
+    server = GrpcServer(
+        "127.0.0.1:0", HmacAuthenticator.derive(master, "server", roster)
+    )
     conns = []
     server.on_conn(lambda c: (c.handle(handler), c.start(), conns.append(c)))
     server.listen()
     try:
         # eve signs with a key derived from a DIFFERENT master secret
-        eve = GrpcClient(HmacAuthenticator(b"wrong-master", "eve"))
-        conn = eve.dial(DialOpts(f"127.0.0.1:{server.port}"))
+        eve = GrpcClient(HmacAuthenticator.derive(b"wrong-master", "eve", roster))
+        conn = eve.dial(DialOpts(f"127.0.0.1:{server.port}", conn_id="server"))
         conn.start()
         conn.send(_val_msg("eve"))
         # honest bob gets through on the same server
-        bob = GrpcClient(HmacAuthenticator(master, "bob"))
-        bconn = bob.dial(DialOpts(f"127.0.0.1:{server.port}"))
+        bob = GrpcClient(HmacAuthenticator.derive(master, "bob", roster))
+        bconn = bob.dial(DialOpts(f"127.0.0.1:{server.port}", conn_id="server"))
         bconn.start()
         bconn.send(_val_msg("bob"))
         got = handler.inbox.get(timeout=5)

@@ -46,7 +46,7 @@ def make_hb_network(
         net.join(
             node_id,
             hb,
-            HmacAuthenticator(keys[node_id].mac_master, node_id)
+            HmacAuthenticator(node_id, keys[node_id].mac_keys)
             if auth
             else None,
         )

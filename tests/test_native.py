@@ -96,7 +96,7 @@ def test_hbbft_epoch_on_cpp_backend():
             out=ChannelBroadcaster(net, node_id, ids),
         )
         nodes[node_id] = hb
-        net.join(node_id, hb, HmacAuthenticator(keys[node_id].mac_master, node_id))
+        net.join(node_id, hb, HmacAuthenticator(node_id, keys[node_id].mac_keys))
     push_txs(nodes, 8)
     for hb in nodes.values():
         hb.start_epoch()

@@ -49,7 +49,7 @@ def make_rbc_network(n, proposer_idx=0, seed=None, auth=False, epoch=0):
         net.join(
             node_id,
             RbcHandler(rbc),
-            HmacAuthenticator(master, node_id) if auth else None,
+            HmacAuthenticator.derive(master, node_id, ids) if auth else None,
         )
     return cfg, net, rbcs, proposer
 

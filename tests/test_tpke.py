@@ -163,3 +163,53 @@ def test_keys_distinct_between_tpke_and_coin_seeds():
     pub_a, _ = tpke.deal(4, 2, seed=1)
     pub_b, _ = tpke.deal(4, 2, seed=2)
     assert pub_a.master != pub_b.master
+
+
+class TestGroupMembership:
+    """ADVICE.md round-1 high finding: ciphertext c1 values outside the
+    prime-order subgroup must be rejected before share issuance."""
+
+    def test_rejects_non_members(self):
+        for bad in (0, 1, mm.P - 1, mm.P, mm.P + 5):
+            assert not tpke.is_group_element(bad)
+
+    def test_rejects_non_residue(self):
+        # a generator of the full group Z_p* is not a QR; find one by
+        # scanning small values (p = 2q+1 safe prime: non-residues have
+        # order 2q, i.e. x^q == -1)
+        x = next(
+            x for x in range(2, 100) if pow(x, mm.Q, mm.P) == mm.P - 1
+        )
+        assert not tpke.is_group_element(x)
+
+    def test_accepts_honest_values(self):
+        assert tpke.is_group_element(mm.G)
+        pub, _ = tpke.deal(4, 2, seed=3)
+        assert tpke.is_group_element(pub.master)
+        ct = tpke.Tpke(pub).encrypt(b"m")
+        assert tpke.is_group_element(ct.c1)
+
+    def test_deserialize_rejects_poisoned_c1(self):
+        import struct
+
+        import pytest
+
+        from cleisthenes_tpu.protocol.honeybadger import (
+            deserialize_ciphertext,
+            serialize_ciphertext,
+        )
+
+        c2 = b"\x00" * 8
+        for bad_c1 in (0, 1, mm.P - 1):
+            blob = (
+                bad_c1.to_bytes(32, "big")
+                + struct.pack(">I", len(c2))
+                + c2
+                + b"\x11" * 32
+            )
+            with pytest.raises(ValueError):
+                deserialize_ciphertext(blob)
+        # round-trip of an honest ciphertext still works
+        pub, _ = tpke.deal(4, 2, seed=5)
+        ct = tpke.Tpke(pub).encrypt(b"honest")
+        assert deserialize_ciphertext(serialize_ciphertext(ct)) == ct

@@ -82,43 +82,83 @@ class TestCodec:
             decode_message(bytes(wire))
 
 
+_ROSTER = ["n0", "n1", "n2", "nX"]
+
+
+def _auth(self_id, master=b"master", roster=_ROSTER):
+    return HmacAuthenticator.derive(master, self_id, roster)
+
+
 class TestAuthenticator:
     def test_sign_verify(self):
-        auth = HmacAuthenticator(b"master", "n0")
-        msg = auth.sign(Message("n0", 1.0, RbcPayload(RbcType.READY, "p", 0, b"h")))
+        n0, n1 = _auth("n0"), _auth("n1")
+        msg = n0.sign(
+            Message("n0", 1.0, RbcPayload(RbcType.READY, "p", 0, b"h")), "n1"
+        )
         assert msg.signature != b""
-        assert auth.verify(msg)
+        assert n1.verify(msg)
 
     def test_tamper_detected(self):
-        auth = HmacAuthenticator(b"master", "n0")
-        msg = auth.sign(Message("n0", 1.0, RbcPayload(RbcType.READY, "p", 0, b"h")))
+        n0, n1 = _auth("n0"), _auth("n1")
+        msg = n0.sign(
+            Message("n0", 1.0, RbcPayload(RbcType.READY, "p", 0, b"h")), "n1"
+        )
         forged = Message("n0", 1.0, RbcPayload(RbcType.READY, "p", 1, b"h"), msg.signature)
-        assert not auth.verify(forged)
+        assert not n1.verify(forged)
 
-    def test_impersonation_detected(self):
-        """A MAC made with n0's key must not authenticate a message
-        claiming sender n1 (key derivation binds the sender id)."""
-        import hashlib
+    def test_third_member_cannot_forge_between_pair(self):
+        """The ADVICE.md round-1 finding: with per-SENDER keys any
+        roster member could compute every other member's key.  With
+        per-PAIR keys, Byzantine nX (holding all of ITS pair keys)
+        still cannot MAC a message n1->n0, because k_{n0,n1} is not
+        among them."""
         import hmac as hmac_mod
+        import hashlib
 
         from cleisthenes_tpu.transport.message import signing_bytes
 
+        nX, n0 = _auth("nX"), _auth("n0")
         msg = Message("n1", 1.0, RbcPayload(RbcType.READY, "p", 0, b"h"))
-        n0_key = hashlib.sha256(b"mac|" + b"master" + b"|" + b"n0").digest()
-        forged = Message(
-            msg.sender_id,
-            msg.timestamp,
-            msg.payload,
-            hmac_mod.new(n0_key, signing_bytes(msg), hashlib.sha256).digest(),
+        # nX tries every key it holds
+        for key in nX._peer_keys.values():
+            forged = Message(
+                msg.sender_id,
+                msg.timestamp,
+                msg.payload,
+                hmac_mod.new(key, signing_bytes(msg), hashlib.sha256).digest(),
+            )
+            assert not n0.verify(forged)
+
+    def test_wrong_pair_key_rejected(self):
+        """A frame n0 signed for n1 must not verify at n2 (receiver
+        binding)."""
+        n0, n2 = _auth("n0"), _auth("n2")
+        msg = n0.sign(
+            Message("n0", 1.0, RbcPayload(RbcType.READY, "p", 0, b"h")), "n1"
         )
-        assert not HmacAuthenticator(b"master", "nX").verify(forged)
+        assert not n2.verify(msg)
+
+    def test_unknown_sender_rejected(self):
+        n0 = _auth("n0")
+        stranger = Message(
+            "not-in-roster", 1.0, RbcPayload(RbcType.READY, "p", 0, b"h")
+        )
+        assert not n0.verify(stranger)
 
     def test_sign_refuses_wrong_sender(self):
         """sign() raises rather than emit a message every receiver
         would silently reject."""
-        auth = HmacAuthenticator(b"master", "n0")
+        auth = _auth("n0")
         with pytest.raises(ValueError):
-            auth.sign(Message("n1", 1.0, RbcPayload(RbcType.READY, "p", 0, b"h")))
+            auth.sign(
+                Message("n1", 1.0, RbcPayload(RbcType.READY, "p", 0, b"h")),
+                "n2",
+            )
+
+    def test_sign_requires_receiver(self):
+        auth = _auth("n0")
+        with pytest.raises(ValueError):
+            auth.sign(Message("n0", 1.0, RbcPayload(RbcType.READY, "p", 0, b"h")))
 
     def test_payload_trailing_bytes_rejected(self):
         """Non-canonical payload bodies (trailing junk inside the
@@ -149,10 +189,12 @@ class _Collector:
 def _mk_net(n=3, seed=None, master=b"k"):
     net = ChannelNetwork(seed=seed)
     collectors = {}
-    for i in range(n):
-        nid = f"n{i}"
+    roster = [f"n{i}" for i in range(n)]
+    for nid in roster:
         collectors[nid] = _Collector()
-        net.join(nid, collectors[nid], HmacAuthenticator(master, nid))
+        net.join(
+            nid, collectors[nid], HmacAuthenticator.derive(master, nid, roster)
+        )
     return net, collectors
 
 
