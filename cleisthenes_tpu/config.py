@@ -45,9 +45,10 @@ class Config:
         An int makes sampling deterministic, for tests/benchmarks only.
       coin_seed: shared setup seed for the threshold common-coin and
         TPKE key generation in trusted-dealer mode.
-      mesh_shape: optional device-mesh layout (validators, shardlen)
-        for sharding the crypto plane across TPU devices; None means
-        single-device.
+      mesh_shape: optional ('v', 'l') device-mesh layout — (validator
+        axis, shard-length axis) — for sharding the crypto plane
+        across TPU devices via parallel.mesh.CryptoMesh; None means
+        single-device.  Only consumed by the 'tpu' backend.
     """
 
     n: int = 4
@@ -74,6 +75,10 @@ class Config:
             )
         if self.crypto_backend not in ("cpu", "cpp", "tpu"):
             raise ValueError(f"unknown crypto_backend {self.crypto_backend!r}")
+        if self.mesh_shape is not None:
+            from cleisthenes_tpu.parallel.mesh import validate_mesh_shape
+
+            self.mesh_shape = validate_mesh_shape(self.mesh_shape)
 
     @property
     def data_shards(self) -> int:

@@ -76,7 +76,9 @@ class ErasureCoder(abc.ABC):
         )
 
 
-def make_erasure_coder(backend: str, n: int, k: int) -> ErasureCoder:
+def make_erasure_coder(
+    backend: str, n: int, k: int, mesh=None
+) -> ErasureCoder:
     if backend == "cpu":
         from cleisthenes_tpu.ops.rs_cpu import CpuErasureCoder
 
@@ -88,7 +90,7 @@ def make_erasure_coder(backend: str, n: int, k: int) -> ErasureCoder:
     if backend == "tpu":
         from cleisthenes_tpu.ops.rs_xla import XlaErasureCoder
 
-        return XlaErasureCoder(n, k)
+        return XlaErasureCoder(n, k, mesh=mesh)
     raise ValueError(f"unknown erasure backend {backend!r}")
 
 
@@ -98,19 +100,32 @@ class BatchCrypto:
     Grows as subsystems land: erasure coding, Merkle forest, TPKE,
     common coin.  ``get_backend(config)`` is the single construction
     point used by the protocol layer.
+
+    ``mesh_shape`` (Config.mesh_shape) shards the whole crypto plane
+    over a ('v', 'l') device mesh (parallel.mesh.CryptoMesh): RS
+    batches partition over both axes, hash/modexp batches over all
+    devices flat.  Only meaningful under the 'tpu' backend — the numpy
+    and native backends are single-host by definition.
     """
 
-    def __init__(self, backend: str, n: int, f: int, k: int):
+    def __init__(
+        self, backend: str, n: int, f: int, k: int, mesh_shape=None
+    ):
         from cleisthenes_tpu.ops.merkle import make_merkle
 
         self.backend = backend
         self.n = n
         self.f = f
         self.k = k
-        self.erasure = make_erasure_coder(backend, n, k)
+        self.mesh = None
+        if mesh_shape is not None and backend == "tpu":
+            from cleisthenes_tpu.parallel.mesh import make_crypto_mesh
+
+            self.mesh = make_crypto_mesh(tuple(mesh_shape))
+        self.erasure = make_erasure_coder(backend, n, k, mesh=self.mesh)
         # the native backend accelerates the GF plane; hashing and
         # modexp stay on their cpu reference implementations
-        self.merkle = make_merkle(self.engine_backend)
+        self.merkle = make_merkle(self.engine_backend, mesh=self.mesh)
 
     @property
     def engine_backend(self) -> str:
@@ -122,18 +137,22 @@ class BatchCrypto:
         (pub: tpke.ThresholdPublicKey)."""
         from cleisthenes_tpu.ops.tpke import Tpke
 
-        return Tpke(pub, backend=self.engine_backend)
+        return Tpke(pub, backend=self.engine_backend, mesh=self.mesh)
 
     def coin(self, pub):
         """Common-coin service bound to this backend."""
         from cleisthenes_tpu.ops.coin import CommonCoin
 
-        return CommonCoin(pub, backend=self.engine_backend)
+        return CommonCoin(pub, backend=self.engine_backend, mesh=self.mesh)
 
 
 def get_backend(config) -> BatchCrypto:
     # k comes from Config.data_shards, the single source of the
     # N - 2f formula (validated there against n >= 3f+1).
     return BatchCrypto(
-        config.crypto_backend, config.n, config.f, config.data_shards
+        config.crypto_backend,
+        config.n,
+        config.f,
+        config.data_shards,
+        mesh_shape=config.mesh_shape,
     )

@@ -39,9 +39,12 @@ def coin_base(coin_id: bytes) -> int:
 class CommonCoin:
     """One coin key set shared by all BBA instances of a network."""
 
-    def __init__(self, pub: ThresholdPublicKey, backend: str = "cpu"):
+    def __init__(
+        self, pub: ThresholdPublicKey, backend: str = "cpu", mesh=None
+    ):
         self.pub = pub
         self.backend = backend
+        self.mesh = mesh
 
     def share(
         self, secret: ThresholdSecretShare, coin_id: bytes
@@ -57,6 +60,7 @@ class CommonCoin:
             shares,
             b"coin|" + coin_id,
             self.backend,
+            self.mesh,
         )
 
     def combine(self, coin_id: bytes, shares: Sequence[DhShare]) -> int:

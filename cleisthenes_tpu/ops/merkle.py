@@ -185,18 +185,38 @@ class XlaMerkle(MerkleBackend):
     of ONE XLA program (the base class would round-trip host<->device
     per level).  The batch axis is padded to the next power of two
     (min 8) so each (bucket, length) pair compiles exactly once.
+
+    With a ``parallel.mesh.CryptoMesh``, the batch axis shards over
+    EVERY mesh device flat (``P(('v','l'))``): hashing is sequential
+    within a message but independent across the batch, so trees and
+    branch proofs scatter across chips with zero collectives.
     """
 
-    @staticmethod
-    def _bucket(b: int) -> int:
+    def __init__(self, mesh=None):
+        self._mesh = mesh
+
+    def _bucket(self, b: int) -> int:
+        import math
+
         bucket = 8
         while bucket < b:
             bucket <<= 1
+        if self._mesh is not None:
+            # padded batch must divide across the flat device count;
+            # lcm keeps the power-of-two compile-bucketing AND handles
+            # non-power-of-two meshes (e.g. (3, 2))
+            bucket = math.lcm(bucket, self._mesh.n_devices)
         return bucket
 
-    def _hash_batch(self, msgs: np.ndarray) -> np.ndarray:
+    def _put(self, x):
         import jax.numpy as jnp
 
+        x = jnp.asarray(x)
+        if self._mesh is None:
+            return x
+        return self._mesh.put_flat(x)[0]
+
+    def _hash_batch(self, msgs: np.ndarray) -> np.ndarray:
         from cleisthenes_tpu.ops.sha256_xla import sha256_batch
 
         b = msgs.shape[0]
@@ -205,11 +225,9 @@ class XlaMerkle(MerkleBackend):
             msgs = np.concatenate(
                 [msgs, np.zeros((bucket - b, msgs.shape[1]), dtype=np.uint8)]
             )
-        return np.asarray(sha256_batch(jnp.asarray(msgs)))[:b]
+        return np.asarray(sha256_batch(self._put(msgs)))[:b]
 
     def build_batch(self, shards: np.ndarray) -> List[MerkleTree]:
-        import jax.numpy as jnp
-
         from cleisthenes_tpu.ops.sha256_xla import build_forest
 
         b, n, _ = shards.shape
@@ -219,7 +237,7 @@ class XlaMerkle(MerkleBackend):
                 [shards, np.zeros((bucket - b,) + shards.shape[1:], np.uint8)]
             )
         # (bucket, 2p-1, 32): the whole forest in one transfer
-        forest = np.asarray(build_forest(jnp.asarray(shards)))
+        forest = np.asarray(build_forest(self._put(shards)))
         p = _next_pow2(n)
         levels = []
         off, width = 0, p
@@ -239,8 +257,6 @@ class XlaMerkle(MerkleBackend):
         branches: np.ndarray,
         indices: np.ndarray,
     ) -> np.ndarray:
-        import jax.numpy as jnp
-
         from cleisthenes_tpu.ops.sha256_xla import verify_branches
 
         b = leaves.shape[0]
@@ -253,19 +269,19 @@ class XlaMerkle(MerkleBackend):
             return np.concatenate([a, reps])
 
         ok = verify_branches(
-            jnp.asarray(pad(np.ascontiguousarray(roots, dtype=np.uint8))),
-            jnp.asarray(pad(np.ascontiguousarray(leaves, dtype=np.uint8))),
-            jnp.asarray(pad(np.ascontiguousarray(branches, dtype=np.uint8))),
-            jnp.asarray(pad(np.asarray(indices, dtype=np.uint32))),
+            self._put(pad(np.ascontiguousarray(roots, dtype=np.uint8))),
+            self._put(pad(np.ascontiguousarray(leaves, dtype=np.uint8))),
+            self._put(pad(np.ascontiguousarray(branches, dtype=np.uint8))),
+            self._put(pad(np.asarray(indices, dtype=np.uint32))),
         )
         return np.asarray(ok)[:b]
 
 
-def make_merkle(backend: str) -> MerkleBackend:
+def make_merkle(backend: str, mesh=None) -> MerkleBackend:
     if backend == "cpu":
         return CpuMerkle()
     if backend == "tpu":
-        return XlaMerkle()
+        return XlaMerkle(mesh=mesh)
     raise ValueError(f"unknown merkle backend {backend!r}")
 
 

@@ -77,17 +77,30 @@ _decode_kernel_shared = jax.jit(jax.vmap(_decode_kernel, in_axes=(None, 0)))
 
 
 class XlaErasureCoder(ErasureCoder):
-    def __init__(self, n: int, k: int):
+    def __init__(self, n: int, k: int, mesh=None):
         super().__init__(n, k)
         self.matrix = gf256.systematic_rs_matrix(n, k)
         self._g_enc = jnp.asarray(
             gf256.lift_to_bits(self.matrix[k:]), dtype=jnp.bfloat16
         )
+        # parallel.mesh.CryptoMesh: batch ops shard (B, k, L) as
+        # P('v', None, 'l') — the contraction is over the k axis, so
+        # both the instance axis and the shard-length axis partition
+        # with zero collectives (SURVEY.md §5.7's length sharding).
+        self._mesh = mesh
         # Per-instance cache of lifted decode matrices by erasure
         # pattern (class-level lru_cache would pin instances alive).
         self._decode_bits = functools.lru_cache(maxsize=512)(
             self._decode_bits_impl
         )
+
+    def _put_vl(self, data: np.ndarray):
+        """Shard a (B, r, L) batch over the mesh, padding B to the 'v'
+        dim and L to the 'l' dim; returns (device_array, b, l)."""
+        v, l_dim = self._mesh.shape
+        data, b = self._mesh.pad_rows(data, v)
+        data, l = self._mesh.pad_cols(data, l_dim)
+        return self._mesh.put_vl(jnp.asarray(data)), b, l
 
     def encode(self, data: np.ndarray) -> np.ndarray:
         data = np.ascontiguousarray(data, dtype=np.uint8)
@@ -110,7 +123,13 @@ class XlaErasureCoder(ErasureCoder):
         assert data.ndim == 3 and data.shape[1] == self.k, data.shape
         if self.n == self.k:
             return data.copy()
-        return np.asarray(_encode_kernel_batch(self._g_enc, jnp.asarray(data)))
+        if self._mesh is None:
+            return np.asarray(
+                _encode_kernel_batch(self._g_enc, jnp.asarray(data))
+            )
+        dev, b, l = self._put_vl(data)
+        out = _encode_kernel_batch(self._g_enc, dev)
+        return np.asarray(out)[:b, :, :l]
 
     def decode_batch(
         self, indices: np.ndarray, shards: np.ndarray
@@ -118,13 +137,23 @@ class XlaErasureCoder(ErasureCoder):
         shards = np.ascontiguousarray(shards, dtype=np.uint8)
         patterns = [self._normalize_indices(ix) for ix in indices]
         if len(set(patterns)) == 1:
-            return np.asarray(
-                _decode_kernel_shared(
-                    self._decode_bits(patterns[0]), jnp.asarray(shards)
+            g = self._decode_bits(patterns[0])
+            if self._mesh is None:
+                return np.asarray(
+                    _decode_kernel_shared(g, jnp.asarray(shards))
                 )
-            )
+            dev, b, l = self._put_vl(shards)
+            return np.asarray(_decode_kernel_shared(g, dev))[:b, :, :l]
         g = jnp.stack([self._decode_bits(p) for p in patterns])
-        return np.asarray(_decode_kernel_batch(g, jnp.asarray(shards)))
+        if self._mesh is None:
+            return np.asarray(_decode_kernel_batch(g, jnp.asarray(shards)))
+        dev, b, l = self._put_vl(shards)
+        v = self._mesh.shape[0]
+        # the per-instance decode matrices shard batch-only: their
+        # trailing axes are the contraction dims
+        g_np, _ = self._mesh.pad_rows(np.asarray(g), v)
+        g_dev = self._mesh.put_v(jnp.asarray(g_np))
+        return np.asarray(_decode_kernel_batch(g_dev, dev))[:b, :, :l]
 
 
 __all__ = ["XlaErasureCoder"]

@@ -198,16 +198,18 @@ def verify_shares(
     shares: Sequence[DhShare],
     context: bytes,
     backend: str = "cpu",
+    mesh=None,
 ) -> List[bool]:
     """Batched CP verification: recompute A1 = g^z * h_i^{-e},
     A2 = base^z * d^{-e}, accept iff e == H(transcript).
 
     All 2*len(shares) dual-exponentiations run in ONE TPU dispatch
-    under backend='tpu'.
+    under backend='tpu'; with a CryptoMesh the batch shards across
+    every mesh device.
     """
     if not shares:
         return []
-    eng = get_engine(backend)
+    eng = get_engine(backend, mesh)
     u1, e1, u2, e2 = [], [], [], []
     for sh in shares:
         if not (1 <= sh.index <= pub.n):
@@ -336,9 +338,12 @@ def _keystream(key: bytes, length: int) -> bytes:
 class Tpke:
     """Threshold decryption service for one key set."""
 
-    def __init__(self, pub: ThresholdPublicKey, backend: str = "cpu"):
+    def __init__(
+        self, pub: ThresholdPublicKey, backend: str = "cpu", mesh=None
+    ):
         self.pub = pub
         self.backend = backend
+        self.mesh = mesh
 
     # TPKE.Encrypt (docs/THRESHOLD_ENCRYPTION-EN.md:34)
     def encrypt(self, msg: bytes, rng=secrets) -> Ciphertext:
@@ -365,7 +370,8 @@ class Tpke:
         self, ct: Ciphertext, shares: Sequence[DhShare]
     ) -> List[bool]:
         return verify_shares(
-            self.pub, ct.c1, shares, self._context(ct), self.backend
+            self.pub, ct.c1, shares, self._context(ct), self.backend,
+            self.mesh,
         )
 
     # TPKE.Decrypt (docs/THRESHOLD_ENCRYPTION-EN.md:36)

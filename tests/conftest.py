@@ -1,8 +1,10 @@
 """Test harness configuration.
 
 Tests never require real TPU hardware: JAX is pinned to the CPU
-platform with 8 virtual devices so multi-device sharding (shard_map
-over a Mesh) is exercised exactly as it would be on a v5e slice.
+platform with 8 virtual devices so multi-device sharding — the
+('v','l') CryptoMesh with GSPMD-partitioned crypto kernels, see
+parallel/mesh.py and tests/test_mesh.py — compiles and executes the
+same partitioned programs a v5e slice would run (minus the ICI).
 
 The env-var route (JAX_PLATFORMS=cpu) is NOT enough here: the host
 image's sitecustomize registers the axon TPU PJRT plugin at

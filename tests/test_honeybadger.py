@@ -27,8 +27,14 @@ def make_hb_network(
     auto_propose=True,
     key_seed=33,
     crypto_backend="cpu",
+    mesh_shape=None,
 ):
-    cfg = Config(n=n, batch_size=batch_size, crypto_backend=crypto_backend)
+    cfg = Config(
+        n=n,
+        batch_size=batch_size,
+        crypto_backend=crypto_backend,
+        mesh_shape=mesh_shape,
+    )
     ids = [f"node{i}" for i in range(n)]
     keys = setup_keys(cfg, ids, seed=key_seed)
     net = ChannelNetwork(seed=seed)
