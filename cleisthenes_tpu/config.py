@@ -60,6 +60,11 @@ class Config:
     seed: Optional[int] = None
     coin_seed: int = 1
     mesh_shape: Optional[tuple] = None
+    # Epoch pipelining (BASELINE config 5): propose into epoch e+1 the
+    # moment epoch e's ACS outputs, so e+1's RS-encode/Merkle-forest
+    # and VAL/ECHO exchange overlap e's decryption-share phase.
+    # Commit order is unaffected (commits gate on the epoch counter).
+    epoch_pipelining: bool = True
 
     def __post_init__(self) -> None:
         if self.n < 1:

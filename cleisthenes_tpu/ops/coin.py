@@ -63,6 +63,12 @@ class CommonCoin:
             self.mesh,
         )
 
+    def group_params(self, coin_id: bytes):
+        """(pub, base, context) for this coin — the key the protocol
+        hub uses to fold coin-share verification into one cross-
+        instance tpke.verify_share_groups dispatch."""
+        return self.pub, coin_base(coin_id), b"coin|" + coin_id
+
     def combine(self, coin_id: bytes, shares: Sequence[DhShare]) -> int:
         """Full 256-bit coin value from >= f+1 verified shares."""
         val = tpke.combine_shares(shares, self.pub.threshold)

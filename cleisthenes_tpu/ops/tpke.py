@@ -33,7 +33,7 @@ import dataclasses
 import hashlib
 import hmac
 import secrets
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from cleisthenes_tpu.ops.modmath import G, P, Q, get_engine
 
@@ -192,6 +192,64 @@ def issue_share(
     return DhShare(index=share.index, d=d, e=e, z=z)
 
 
+def verify_share_groups(
+    groups: Sequence[tuple],
+    backend: str = "cpu",
+    mesh=None,
+) -> List[List[bool]]:
+    """Batched CP verification across heterogeneous groups.
+
+    ``groups`` is a sequence of ``(pub, base, shares, context)`` — e.g.
+    one group per (proposer ciphertext) or per (BBA instance, round)
+    coin — and ALL of their CP proofs run as ONE dual-exponentiation
+    dispatch: recompute A1 = g^z * h_i^{-e}, A2 = base^z * d^{-e},
+    accept iff e == H(transcript).  This is the cross-instance batching
+    the protocol hub uses: an epoch's N TPKE ciphertexts and its
+    concurrent BBA coins verify together instead of one dispatch per
+    instance (the reference's cost model is 4N^2 shares/epoch,
+    docs/HONEYBADGER-EN.md:93-94).
+    """
+    if not groups:
+        return []
+    eng = get_engine(backend, mesh)
+    u1, e1, u2, e2 = [], [], [], []
+    for pub, base, shares, _context in groups:
+        for sh in shares:
+            if not (1 <= sh.index <= pub.n):
+                # out-of-roster index: verified vacuously false below by
+                # pinning to vk=1 (never matches an honest transcript)
+                hi = 1
+            else:
+                hi = pub.verification_keys[sh.index - 1]
+            neg_e = (-sh.e) % Q
+            # A1 = g^z * hi^{-e}
+            u1.append(G); e1.append(sh.z % Q); u2.append(hi); e2.append(neg_e)
+            # A2 = base^z * d^{-e}
+            u1.append(base); e1.append(sh.z % Q); u2.append(sh.d % P); e2.append(neg_e)
+    a = eng.dual_pow_batch(u1, e1, u2, e2)
+    out: List[List[bool]] = []
+    off = 0
+    for pub, base, shares, context in groups:
+        res = []
+        for sh in shares:
+            a1, a2 = a[off], a[off + 1]
+            off += 2
+            if not (1 <= sh.index <= pub.n) or not (0 < sh.d < P):
+                res.append(False)
+                continue
+            hi = pub.verification_keys[sh.index - 1]
+            e_want = (
+                _hash_to_int(
+                    b"cp", context, _ibytes(base), _ibytes(hi), _ibytes(sh.d),
+                    _ibytes(a1), _ibytes(a2),
+                )
+                % Q
+            )
+            res.append(e_want == sh.e % Q)
+        out.append(res)
+    return out
+
+
 def verify_shares(
     pub: ThresholdPublicKey,
     base: int,
@@ -200,49 +258,16 @@ def verify_shares(
     backend: str = "cpu",
     mesh=None,
 ) -> List[bool]:
-    """Batched CP verification: recompute A1 = g^z * h_i^{-e},
-    A2 = base^z * d^{-e}, accept iff e == H(transcript).
-
-    All 2*len(shares) dual-exponentiations run in ONE TPU dispatch
-    under backend='tpu'; with a CryptoMesh the batch shards across
-    every mesh device.
-    """
+    """Single-group convenience over ``verify_share_groups``."""
     if not shares:
         return []
-    eng = get_engine(backend, mesh)
-    u1, e1, u2, e2 = [], [], [], []
-    for sh in shares:
-        if not (1 <= sh.index <= pub.n):
-            # out-of-roster index: verified vacuously false below by
-            # pinning to vk=1 (never matches an honest transcript)
-            hi = 1
-        else:
-            hi = pub.verification_keys[sh.index - 1]
-        neg_e = (-sh.e) % Q
-        # A1 = g^z * hi^{-e}
-        u1.append(G); e1.append(sh.z % Q); u2.append(hi); e2.append(neg_e)
-        # A2 = base^z * d^{-e}
-        u1.append(base); e1.append(sh.z % Q); u2.append(sh.d % P); e2.append(neg_e)
-    a = eng.dual_pow_batch(u1, e1, u2, e2)
-    out = []
-    for i, sh in enumerate(shares):
-        if not (1 <= sh.index <= pub.n) or not (0 < sh.d < P):
-            out.append(False)
-            continue
-        hi = pub.verification_keys[sh.index - 1]
-        e_want = (
-            _hash_to_int(
-                b"cp", context, _ibytes(base), _ibytes(hi), _ibytes(sh.d),
-                _ibytes(a[2 * i]), _ibytes(a[2 * i + 1]),
-            )
-            % Q
-        )
-        out.append(e_want == sh.e % Q)
-    return out
+    return verify_share_groups(
+        [(pub, base, shares, context)], backend, mesh
+    )[0]
 
 
 class SharePool:
-    """Sender-keyed pool of DhShares with batched verification.
+    """Sender-keyed pool of DhShares with deferred batched verification.
 
     One slot per roster sender (an honest node submits exactly one
     share per context), so a Byzantine peer can only ever occupy — and
@@ -253,46 +278,75 @@ class SharePool:
     another node's valid share, which must not trip the distinct-
     index requirement of Lagrange interpolation).
 
+    Shares sit in a *pending* set until verification verdicts arrive —
+    either via ``try_verified`` (self-contained, one verify call per
+    pool) or via ``collect_pending``/``apply_verdicts`` driven by the
+    protocol hub, which verifies MANY pools' pending shares in one
+    cross-instance dispatch (protocol.hub.CryptoHub).
+
     Shared by the BBA common coin and the TPKE decryption path — the
     two consumers of threshold shares in HBBFT.
     """
 
     def __init__(self, threshold: int):
         self.threshold = threshold
-        self._shares: Dict[str, DhShare] = {}
+        self._pending: Dict[str, DhShare] = {}
+        self._verified: Dict[str, DhShare] = {}
         self._burned: set = set()
 
     def add(self, sender: str, share: DhShare) -> bool:
         """First share per non-burned sender wins."""
-        if sender in self._shares or sender in self._burned:
+        if (
+            sender in self._pending
+            or sender in self._verified
+            or sender in self._burned
+        ):
             return False
-        self._shares[sender] = share
+        self._pending[sender] = share
         return True
 
     def __len__(self) -> int:
-        return len(self._shares)
+        """Potential size: pending + verified (the threshold trigger)."""
+        return len(self._pending) + len(self._verified)
 
-    def try_verified(self, verify_fn) -> Optional[List[DhShare]]:
-        """If >= threshold shares are pooled, batch-verify them all
-        (``verify_fn(shares) -> List[bool]``, ONE TPU dispatch under
-        the 'tpu' backend), burn the senders of invalid ones, and
-        return >= threshold index-distinct valid shares — or None if
-        not there yet."""
-        if len(self._shares) < self.threshold:
-            return None
-        senders = list(self._shares)
-        shares = [self._shares[s] for s in senders]
-        ok = verify_fn(shares)
-        by_index: Dict[int, DhShare] = {}
-        for sender, share, good in zip(senders, shares, ok):
+    def collect_pending(self) -> Tuple[List[str], List[DhShare]]:
+        """The unverified shares, for an external batched verify."""
+        senders = list(self._pending)
+        return senders, [self._pending[s] for s in senders]
+
+    def apply_verdicts(self, senders: Sequence[str], ok: Sequence[bool]) -> None:
+        """Record external verification verdicts: valid shares move to
+        the verified set, senders of invalid ones burn."""
+        for sender, good in zip(senders, ok):
+            share = self._pending.pop(sender, None)
+            if share is None:
+                continue
             if good:
-                by_index.setdefault(share.index, share)
+                self._verified[sender] = share
             else:
-                del self._shares[sender]
                 self._burned.add(sender)
+
+    def ready(self) -> Optional[List[DhShare]]:
+        """>= threshold index-distinct verified shares, or None."""
+        by_index: Dict[int, DhShare] = {}
+        for share in self._verified.values():
+            by_index.setdefault(share.index, share)
         if len(by_index) < self.threshold:
             return None
         return list(by_index.values())
+
+    def try_verified(self, verify_fn) -> Optional[List[DhShare]]:
+        """Self-contained threshold check: if >= threshold shares are
+        pooled, batch-verify the pending ones (``verify_fn(shares) ->
+        List[bool]``, ONE dispatch under 'tpu'), burn invalid senders,
+        and return >= threshold index-distinct valid shares — or None
+        if not there yet."""
+        if len(self) < self.threshold:
+            return None
+        senders, shares = self.collect_pending()
+        if shares:
+            self.apply_verdicts(senders, verify_fn(shares))
+        return self.ready()
 
 
 def combine_shares(
@@ -357,8 +411,13 @@ class Tpke:
         tag = hmac.new(key, _ibytes(c1) + c2, hashlib.sha256).digest()
         return Ciphertext(c1=c1, c2=c2, tag=tag)
 
-    def _context(self, ct: Ciphertext) -> bytes:
+    def context(self, ct: Ciphertext) -> bytes:
+        """The CP-proof context binding shares to this ciphertext
+        (public: the protocol hub groups cross-instance verifies by
+        (pub, base, context))."""
         return b"tpke|" + _ibytes(ct.c1) + hashlib.sha256(ct.c2).digest()
+
+    _context = context  # internal alias
 
     # TPKE.DecShare (docs/THRESHOLD_ENCRYPTION-EN.md:35)
     def dec_share(
@@ -404,6 +463,7 @@ __all__ = [
     "deal",
     "issue_share",
     "verify_shares",
+    "verify_share_groups",
     "combine_shares",
     "lagrange_coeff_at_zero",
     "hash_to_group",

@@ -49,6 +49,7 @@ class ACS:
         coin: CommonCoin,
         coin_secret: ThresholdSecretShare,
         out,
+        hub=None,
     ) -> None:
         self.n = config.n
         self.f = config.f
@@ -58,6 +59,12 @@ class ACS:
         # fn(epoch, {proposer: value}) fired exactly once
         self.on_output: Optional[Callable[[int, Dict[str, bytes]], None]] = None
 
+        if hub is None:  # standalone use: one shared hub per ACS so
+            # the epoch's 2N instances still batch together
+            from cleisthenes_tpu.protocol.hub import CryptoHub
+
+            hub = CryptoHub(crypto)
+        self.hub = hub
         self.rbcs: Dict[str, RBC] = {}
         self.bbas: Dict[str, BBA] = {}
         for proposer in self.members:
@@ -69,6 +76,7 @@ class ACS:
                 owner=owner,
                 member_ids=self.members,
                 out=out,
+                hub=hub,
             )
             rbc.on_deliver = self._on_rbc_deliver
             self.rbcs[proposer] = rbc
@@ -81,6 +89,7 @@ class ACS:
                 coin=coin,
                 coin_secret=coin_secret,
                 out=out,
+                hub=hub,
             )
             bba.on_decide = self._on_bba_decide
             self.bbas[proposer] = bba

@@ -106,6 +106,7 @@ class BBA:
         coin: CommonCoin,
         coin_secret: ThresholdSecretShare,
         out,
+        hub=None,
     ) -> None:
         self.n = config.n
         self.f = config.f
@@ -117,6 +118,17 @@ class BBA:
         self.coin = coin
         self.coin_secret = coin_secret
         self.out = out
+        if hub is None:  # standalone use (unit tests): private hub
+            from cleisthenes_tpu.ops.backend import BatchCrypto
+            from cleisthenes_tpu.protocol.hub import CryptoHub
+
+            hub = CryptoHub(
+                BatchCrypto(
+                    coin.backend, config.n, config.f, config.data_shards
+                )
+            )
+        self.hub = hub
+        self.hub.register(epoch, self)
 
         self.round = 0
         self.est: Optional[bool] = None
@@ -288,17 +300,59 @@ class BBA:
             self._maybe_reveal_coin()
 
     def _maybe_reveal_coin(self) -> None:
+        """Threshold reached -> flush the hub: OUR shares verify in the
+        same dispatch as every other concurrent instance's pooled
+        shares (and the epoch's pending TPKE/branch work)."""
         r = self._cur()
         if r.coin_value is not None:
             return
-        coin_id = self._coin_id(self.round)
-        # batched CP verification — ONE TPU dispatch under 'tpu'
-        valid = r.coin_shares.try_verified(
-            lambda shares: self.coin.verify_shares(coin_id, shares)
+        if len(r.coin_shares) < self.coin.pub.threshold:
+            return
+        self.hub.request_flush()
+
+    # -- hub client protocol (protocol.hub.CryptoHub) ----------------------
+
+    def collect_crypto_work(self, branches, decodes, shares) -> None:
+        if self.halted:
+            return
+        r = self._rounds.get(self.round)
+        if r is None or r.coin_value is not None:
+            return
+        senders, shs = r.coin_shares.collect_pending()
+        if not senders:
+            return
+        pub, base, context = self.coin.group_params(
+            self._coin_id(self.round)
         )
+        rnd = self.round
+        shares.append(
+            (
+                pub,
+                base,
+                context,
+                senders,
+                shs,
+                lambda snd, ok, rnd=rnd: self._on_coin_verdicts(
+                    rnd, snd, ok
+                ),
+            )
+        )
+
+    def _on_coin_verdicts(self, rnd: int, senders, ok) -> None:
+        r = self._rounds.get(rnd)
+        if r is not None:
+            r.coin_shares.apply_verdicts(senders, ok)
+
+    def after_crypto_flush(self) -> None:
+        if self.halted:
+            return
+        r = self._rounds.get(self.round)
+        if r is None or r.coin_value is not None:
+            return
+        valid = r.coin_shares.ready()
         if valid is None:
             return
-        r.coin_value = self.coin.toss(coin_id, valid)
+        r.coin_value = self.coin.toss(self._coin_id(self.round), valid)
         self._maybe_advance()
 
     # -- round transition --------------------------------------------------

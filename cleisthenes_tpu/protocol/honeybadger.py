@@ -272,6 +272,13 @@ class HoneyBadger:
         self.crypto: BatchCrypto = get_backend(config)
         self.tpke = self.crypto.tpke(keys.tpke_pub)
         self.coin = self.crypto.coin(keys.coin_pub)
+        # the per-node batched-crypto service every protocol instance
+        # (RBC/BBA across all live epochs, plus this node's TPKE
+        # decryption pools) shares — SURVEY.md §7 hard part 3
+        from cleisthenes_tpu.protocol.hub import CryptoHub
+
+        self.hub = CryptoHub(self.crypto)
+        self.hub.register("node", self)  # permanent: dec-share pools
 
         self.que = TxQueue()
         self.epoch = 0
@@ -323,14 +330,19 @@ class HoneyBadger:
             raise TypeError("transactions are opaque bytes")
         self.que.push(bytes(tx))
 
-    def start_epoch(self) -> None:
+    def start_epoch(self, epoch: Optional[int] = None) -> None:
         """Select a batch, encrypt it, and input it to this epoch's ACS
-        (the intended body of reference honeybadger.go:57-59 sendBatch)."""
-        es = self._epoch_state(self.epoch)
+        (the intended body of reference honeybadger.go:57-59 sendBatch).
+
+        ``epoch`` defaults to the commit frontier; the pipelining path
+        passes ``self.epoch + 1`` to propose ahead (BASELINE config 5).
+        """
+        target = self.epoch if epoch is None else epoch
+        es = self._epoch_state(target)
         if es is None or es.proposed:
             return
         es.proposed = True
-        self.metrics.epoch_proposed(self.epoch)
+        self.metrics.epoch_proposed(target)
         es.my_txs = self._create_batch()
         ct = self.tpke.encrypt(serialize_txs(es.my_txs))
         es.acs.input(serialize_ciphertext(ct))
@@ -423,6 +435,7 @@ class HoneyBadger:
                 coin=self.coin,
                 coin_secret=self.keys.coin_share,
                 out=self.out,
+                hub=self.hub,
             )
             acs.on_output = self._on_acs_output
             es = _EpochState(acs)
@@ -437,6 +450,16 @@ class HoneyBadger:
             return
         es.output = output
         self.metrics.epoch_acs_output(epoch)
+        # Epoch pipelining (BASELINE config 5): this epoch has entered
+        # its decryption-share phase — overlap it with the NEXT epoch's
+        # proposal (RS encode + Merkle forest + VAL/ECHO round trips).
+        if (
+            self.auto_propose
+            and self.config.epoch_pipelining
+            and epoch == self.epoch
+            and len(self.que) > 0
+        ):
+            self.start_epoch(epoch + 1)
         for proposer, ct_bytes in output.items():
             try:
                 ct = deserialize_ciphertext(ct_bytes)
@@ -481,28 +504,69 @@ class HoneyBadger:
     def _try_decrypt(
         self, epoch: int, es: _EpochState, proposer: str
     ) -> None:
+        """Threshold reached -> hub flush: this proposer's shares, every
+        OTHER proposer's pooled shares, the concurrent BBA coins and
+        any pending RBC work all verify in the same batched dispatches
+        (the "TPKE-share-verify ops/sec" BASELINE metric)."""
         if es.output is None or proposer in es.decrypted:
             return
-        ct = es.ciphertexts.get(proposer)
-        if ct is None:
+        if es.ciphertexts.get(proposer) is None:
             return
         pool = es.dec_shares.get(proposer)
-        if pool is None:
+        if pool is None or len(pool) < self.keys.tpke_pub.threshold:
             return
-        # batched CP share verification — ONE TPU dispatch under 'tpu'
-        # (the "TPKE-share-verify ops/sec" BASELINE metric)
-        valid = pool.try_verified(
-            lambda shares: self.tpke.verify_dec_shares(ct, shares)
-        )
-        if valid is None:
-            return
-        try:
-            plain = self.tpke.combine(ct, valid)
-            es.decrypted[proposer] = deserialize_txs(plain)
-        except ValueError:
-            # combined KEM value is independent of the share subset, so
-            # a failed tag/framing fails identically at every node
-            es.decrypted[proposer] = None
+        self.hub.request_flush()
+
+    # -- hub client protocol (protocol.hub.CryptoHub) ----------------------
+
+    def collect_crypto_work(self, branches, decodes, shares) -> None:
+        for epoch, es in self._epochs.items():
+            if es.output is None or es.committed:
+                continue
+            for proposer, ct in es.ciphertexts.items():
+                if proposer in es.decrypted:
+                    continue
+                pool = es.dec_shares.get(proposer)
+                if pool is None:
+                    continue
+                senders, shs = pool.collect_pending()
+                if not senders:
+                    continue
+                shares.append(
+                    (
+                        self.keys.tpke_pub,
+                        ct.c1,
+                        self.tpke.context(ct),
+                        senders,
+                        shs,
+                        lambda snd, ok, pool=pool: pool.apply_verdicts(
+                            snd, ok
+                        ),
+                    )
+                )
+
+    def after_crypto_flush(self) -> None:
+        for epoch, es in list(self._epochs.items()):
+            if es.output is None or es.committed:
+                continue
+            for proposer, ct in list(es.ciphertexts.items()):
+                if proposer in es.decrypted:
+                    continue
+                pool = es.dec_shares.get(proposer)
+                if pool is None:
+                    continue
+                valid = pool.ready()
+                if valid is None:
+                    continue
+                try:
+                    plain = self.tpke.combine(ct, valid)
+                    es.decrypted[proposer] = deserialize_txs(plain)
+                except ValueError:
+                    # combined KEM value is independent of the share
+                    # subset, so a failed tag/framing fails identically
+                    # at every node
+                    es.decrypted[proposer] = None
+            self._maybe_commit(epoch, es)
 
     # -- state sync (crash-recovery catch-up; SURVEY.md §5.3-5.4) ----------
 
@@ -572,6 +636,7 @@ class HoneyBadger:
         if self.batch_log is not None:
             self.batch_log.append(epoch, batch)
         self._epochs.pop(epoch, None)  # any partial local state is moot
+        self.hub.drop_scope(epoch)
         self._sync_responses.clear()
         if self.on_commit is not None:
             self.on_commit(epoch, batch)
@@ -625,6 +690,7 @@ class HoneyBadger:
             e for e in self._epochs if e < self.epoch - KEEP_BEHIND
         ]:
             del self._epochs[stale]
+            self.hub.drop_scope(stale)
         # propose into the new epoch if we have work, or if peers
         # already started it (its state exists from buffered traffic)
         if self.auto_propose and (

@@ -18,9 +18,16 @@ per its own spec (reference docs/RBC-EN.md:28-45):
             READY(h) + N-2f verified shards -> decode and deliver
             (docs/RBC-EN.md:41-42).
 
-The RS encode/decode and Merkle build/verify are delegated to the
-BatchCrypto seam (ops.backend) so they run batched on TPU under
-``crypto_backend='tpu'`` — this module is pure control flow.
+Crypto never runs on the message path: inbound ECHO proofs park in a
+pending pool (one slot per sender) and the decode+root-recheck parks
+as a request; the shared ``protocol.hub.CryptoHub`` pulls all pending
+work — across every concurrent RBC instance of the epoch — into
+batched device dispatches when some instance's quorum threshold makes
+results necessary (SURVEY.md §7 hard part 3's per-epoch accumulation
+buffers; the reference's N^2-branch-hash cost model is
+docs/HONEYBADGER-EN.md:96).  Only the single VAL proof is verified
+inline: our own ECHO must go out immediately and nothing else would
+trigger a flush that early.
 """
 
 from __future__ import annotations
@@ -59,6 +66,7 @@ class RBC:
         owner: str,
         member_ids: Sequence[str],
         out,
+        hub=None,
     ) -> None:
         self.n = config.n
         self.f = config.f
@@ -73,6 +81,12 @@ class RBC:
             )
         self.crypto = crypto
         self.out = out  # PayloadBroadcaster: broadcast / send_to
+        if hub is None:  # standalone use (unit tests): private hub
+            from cleisthenes_tpu.protocol.hub import CryptoHub
+
+            hub = CryptoHub(crypto)
+        self.hub = hub
+        self.hub.register(epoch, self)
 
         # hook set by ACS: fn(proposer_id, value_bytes)
         self.on_deliver: Optional[Callable[[str, bytes], None]] = None
@@ -83,16 +97,22 @@ class RBC:
         # One ECHO and one READY per sender per *instance* (a correct
         # node sends exactly one of each; reference rbc/request.go:30-42
         # repositories are keyed by ConnId).  This also bounds the
-        # number of distinct roots an instance ever tracks to n.
+        # number of distinct roots an instance ever tracks to n.  The
+        # slot is claimed at arrival; a sender whose proof later fails
+        # verification has burned its one vote.
         self._echo_voted: Set[str] = set()
         self._ready_voted: Set[str] = set()
-        # root -> set of ECHO senders
+        # root -> sender -> payload awaiting batched branch verification
+        self._pending_echo: Dict[bytes, Dict[str, RbcPayload]] = {}
+        # root -> set of verified ECHO senders
         self._echo_senders: Dict[bytes, Set[str]] = {}
         # root -> shard_index -> shard bytes (branch-verified)
         self._shards: Dict[bytes, Dict[int, bytes]] = {}
         self._shard_len: Dict[bytes, int] = {}
         # root -> set of READY senders (rbc/request.go ReadyReqRepository)
         self._ready_senders: Dict[bytes, Set[str]] = {}
+        # roots whose decode+recheck is wanted (ready/echo quorum hit)
+        self._decode_req: Set[bytes] = set()
         self._bad_roots: Set[bytes] = set()  # failed interpolation recheck
         self._decoded: Dict[bytes, bytes] = {}  # successful decode cache
         self._value: Optional[bytes] = None
@@ -151,9 +171,10 @@ class RBC:
 
     # -- handlers ----------------------------------------------------------
 
-    def _check_proof(self, payload: RbcPayload) -> bool:
-        """Branch verification (reference rbc/rbc.go:93-95
-        `validateMessage`, docs/RBC-EN.md:35)."""
+    def _precheck(self, payload: RbcPayload) -> bool:
+        """Structural validation — everything except the branch hash
+        check itself (reference rbc/rbc.go:93-95 `validateMessage`
+        minus the crypto, which the hub batches)."""
         if not (0 <= payload.shard_index < self.n):
             return False
         if not (0 < len(payload.shard) <= MAX_SHARD_BYTES):
@@ -174,7 +195,12 @@ class RBC:
         want_len = self._shard_len.get(payload.root_hash)
         if want_len is not None and len(payload.shard) != want_len:
             return False
-        return self.crypto.merkle.verify_branch(
+        return True
+
+    def _check_proof(self, payload: RbcPayload) -> bool:
+        """Full inline verification (VAL only — ECHO proofs batch
+        through the hub)."""
+        return self._precheck(payload) and self.crypto.merkle.verify_branch(
             payload.root_hash,
             payload.shard,
             list(payload.branch),
@@ -203,26 +229,34 @@ class RBC:
             )
         )
 
+    def _echo_potential(self, root: bytes) -> int:
+        """Verified + pending ECHO count for a root — the quorum
+        trigger for a hub flush."""
+        return len(self._echo_senders.get(root, ())) + len(
+            self._pending_echo.get(root, ())
+        )
+
     def _handle_echo(self, sender: str, payload: RbcPayload) -> None:
-        """docs/RBC-EN.md:35-39 (reference rbc/rbc.go:60-62)."""
+        """docs/RBC-EN.md:35-39 (reference rbc/rbc.go:60-62).
+
+        The branch proof is NOT verified here: the payload parks in
+        the pending pool and verifies in the hub's next batched
+        dispatch — triggered below the moment this root could reach
+        its N-f quorum."""
         root = payload.root_hash
         if sender in self._echo_voted:  # one ECHO per sender
             return
-        if not self._check_proof(payload):
+        if not self._precheck(payload):
             return
-        self._echo_voted.add(sender)
-        senders = self._echo_senders.setdefault(root, set())
-        senders.add(sender)
+        self._echo_voted.add(sender)  # slot claimed; burns if invalid
         self._shard_len.setdefault(root, len(payload.shard))
-        self._shards.setdefault(root, {})[payload.shard_index] = payload.shard
-        # N-f valid ECHOs -> interpolate, recheck root, READY
+        self._pending_echo.setdefault(root, {})[sender] = payload
         if (
-            len(senders) >= self.n - self.f
+            self._echo_potential(root) >= self.n - self.f
             and self._ready_root is None
             and root not in self._bad_roots
         ):
-            if self._decode(root) is not None:
-                self._send_ready(root)
+            self.hub.request_flush()
         self._maybe_deliver(root)
 
     def _handle_ready(self, sender: str, payload: RbcPayload) -> None:
@@ -253,37 +287,16 @@ class RBC:
             )
         )
 
-    def _decode(self, root: bytes) -> Optional[bytes]:
-        """Interpolate K shards, re-encode, recompute the Merkle root
-        (the Byzantine-proposer check of docs/RBC-EN.md:37-39;
-        reference rbc/rbc.go:88-90's '< N-2f shards -> error').
-
-        Returns the decoded value or None (insufficient / bad root).
-        """
-        if root in self._decoded:
-            return self._decoded[root]
-        if root in self._bad_roots:
-            return None
-        shards = self._shards.get(root, {})
-        if len(shards) < self.k:
-            return None
-        idxs = sorted(shards)[: self.k]
-        mat = np.stack(
-            [np.frombuffer(shards[i], dtype=np.uint8) for i in idxs]
-        )
-        data = self.crypto.erasure.decode(idxs, mat)
-        full = self.crypto.erasure.encode(data)
-        tree = self.crypto.merkle.build(full)
-        if tree.root != root:
-            self._bad_roots.add(root)
-            return None
-        try:
-            value = join_payload(data)
-        except ValueError:  # corrupt length framing from the proposer
-            self._bad_roots.add(root)
-            return None
-        self._decoded[root] = value
-        return value
+    def _request_decode(self, root: bytes) -> None:
+        """Ask the hub for interpolate + re-encode + root recheck
+        (docs/RBC-EN.md:37-39) at its next flush."""
+        if (
+            root in self._decoded
+            or root in self._bad_roots
+            or root in self._decode_req
+        ):
+            return
+        self._decode_req.add(root)
 
     def _maybe_deliver(self, root: bytes) -> None:
         """2f+1 READY(h) + N-2f verified shards -> deliver
@@ -292,15 +305,110 @@ class RBC:
             return
         if len(self._ready_senders.get(root, ())) < 2 * self.f + 1:
             return
-        value = self._decode(root)
+        value = self._decoded.get(root)
         if value is None:
-            return
+            # decode (or the shard verifications feeding it) is still
+            # pending: stage the request and flush if work exists
+            self._request_decode(root)
+            if root in self._decode_req or self._pending_echo.get(root):
+                self.hub.request_flush()
+            if self.delivered:
+                return  # the flush's quorum pass delivered already
+            value = self._decoded.get(root)
+            if value is None:
+                return
         self._value = value
         # free per-root buffers; the instance is terminal now
         self._shards.clear()
         self._echo_senders.clear()
+        self._pending_echo.clear()
+        self._decode_req.clear()
         if self.on_deliver is not None:
             self.on_deliver(self.proposer, value)
+
+    # -- hub client protocol (protocol.hub.CryptoHub) ----------------------
+
+    def collect_crypto_work(self, branches, decodes, shares) -> None:
+        if self.delivered:
+            return
+        # pending ECHO proofs -> batched branch verification
+        for root, pool in list(self._pending_echo.items()):
+            if not pool:
+                continue
+            items, self._pending_echo[root] = dict(pool), {}
+            for sender, p in items.items():
+                branches.append(
+                    (
+                        p.root_hash,
+                        p.shard,
+                        tuple(p.branch),
+                        p.shard_index,
+                        self._make_echo_cb(root, sender, p),
+                    )
+                )
+        # staged decode requests with enough verified shards
+        for root in list(self._decode_req):
+            if root in self._decoded or root in self._bad_roots:
+                self._decode_req.discard(root)
+                continue
+            shards_map = self._shards.get(root, {})
+            if len(shards_map) < self.k:
+                continue  # stays staged until shards verify
+            self._decode_req.discard(root)
+            idxs = tuple(sorted(shards_map)[: self.k])
+            mat = np.stack(
+                [np.frombuffer(shards_map[i], dtype=np.uint8) for i in idxs]
+            )
+            decodes.append((idxs, mat, root, self._make_decode_cb(root)))
+
+    def _make_echo_cb(self, root: bytes, sender: str, p: RbcPayload):
+        def cb(ok: bool) -> None:
+            if self.delivered or not ok:
+                return  # invalid: the sender's one slot stays burned
+            self._echo_senders.setdefault(root, set()).add(sender)
+            self._shards.setdefault(root, {})[p.shard_index] = p.shard
+
+        return cb
+
+    def _make_decode_cb(self, root: bytes):
+        def cb(data) -> None:
+            if data is None:
+                self._bad_roots.add(root)
+                return
+            try:
+                self._decoded[root] = join_payload(data)
+            except ValueError:  # corrupt length framing from proposer
+                self._bad_roots.add(root)
+
+        return cb
+
+    def after_crypto_flush(self) -> None:
+        """Quorum logic over freshly-verified state; new decode
+        requests staged here are picked up by the flush loop's next
+        collection round."""
+        if self.delivered:
+            return
+        # N-f verified ECHOs -> stage decode (READY follows a
+        # successful root recheck, docs/RBC-EN.md:35-39)
+        for root, senders in list(self._echo_senders.items()):
+            if (
+                len(senders) >= self.n - self.f
+                and self._ready_root is None
+                and root not in self._bad_roots
+            ):
+                self._request_decode(root)
+                if root in self._decoded:
+                    self._send_ready(root)
+        for root in list(self._decoded):
+            if (
+                self._ready_root is None
+                and len(self._echo_senders.get(root, ())) >= self.n - self.f
+            ):
+                self._send_ready(root)
+        for root in list(self._ready_senders):
+            if self.delivered:
+                break
+            self._maybe_deliver(root)
 
 
 __all__ = ["RBC", "MAX_SHARD_BYTES"]

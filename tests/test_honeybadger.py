@@ -262,3 +262,61 @@ def test_hbbft_scale_n16():
         for tx in b.tx_list()
     }
     assert committed == set(txs)
+
+
+class TestEpochPipelining:
+    """BASELINE config 5: epoch e+1's proposal overlaps epoch e's
+    decryption-share phase (Config.epoch_pipelining, default on)."""
+
+    def test_overlap_happens_and_commits_stay_correct(self):
+        cfg, net, nodes = make_hb_network(4, batch_size=8)
+        assert cfg.epoch_pipelining
+        push_txs(nodes, 32)  # several epochs of work
+        for hb in nodes.values():
+            hb.start_epoch()
+        net.run()
+        depth = assert_identical_batches(nodes)
+        assert depth >= 3
+        hb = nodes["node0"]
+        overlaps = 0
+        for e in range(depth - 1):
+            t_next_prop = hb.metrics.trace(e + 1).t_propose
+            t_commit = hb.metrics.trace(e).t_commit
+            if (
+                t_next_prop is not None
+                and t_commit is not None
+                and t_next_prop < t_commit
+            ):
+                overlaps += 1
+        assert overlaps >= 1, "no epoch proposed ahead of the previous commit"
+
+    def test_pipelining_off_still_commits(self):
+        from cleisthenes_tpu.config import Config
+
+        cfg, net, nodes = make_hb_network(4, batch_size=8)
+        for hb in nodes.values():
+            hb.config.epoch_pipelining = False
+        push_txs(nodes, 16)
+        for hb in nodes.values():
+            hb.start_epoch()
+        net.run()
+        depth = assert_identical_batches(nodes)
+        assert depth >= 2
+        # strict sequencing: no epoch proposed before the previous commit
+        hb = nodes["node0"]
+        for e in range(depth - 1):
+            t_next_prop = hb.metrics.trace(e + 1).t_propose
+            t_commit = hb.metrics.trace(e).t_commit
+            if t_next_prop is not None and t_commit is not None:
+                assert t_next_prop >= t_commit
+
+    def test_pipelining_under_adversarial_scheduler(self):
+        cfg, net, nodes = make_hb_network(4, batch_size=8, seed=29)
+        push_txs(nodes, 24)
+        for _ in range(30):
+            for hb in nodes.values():
+                hb.start_epoch()
+            net.run()
+            if all(hb.pending_tx_count() == 0 for hb in nodes.values()):
+                break
+        assert_identical_batches(nodes)

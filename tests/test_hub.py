@@ -1,0 +1,193 @@
+"""CryptoHub: cross-instance batching of the live protocol hot path.
+
+VERDICT.md round-1 item 3: the live path must use the batched kernels.
+These tests prove (a) batched verification agrees with single-shot
+verification, (b) a full epoch's crypto goes through the hub in FEW
+batched dispatches instead of per-message singletons, and (c) invalid
+work is rejected identically through the batched path.
+"""
+
+import numpy as np
+import pytest
+
+from cleisthenes_tpu.config import Config
+from cleisthenes_tpu.ops import tpke
+from cleisthenes_tpu.ops.backend import BatchCrypto
+from cleisthenes_tpu.ops.coin import CommonCoin
+from cleisthenes_tpu.protocol.hub import CryptoHub
+
+
+class TestVerifyShareGroups:
+    """The multi-group dual-pow fold (one dispatch for TPKE + coins)."""
+
+    @pytest.mark.parametrize("backend", ["cpu", "tpu"])
+    def test_groups_agree_with_single_calls(self, backend):
+        pub_a, shares_a = tpke.deal(4, 2, seed=21)
+        pub_b, shares_b = tpke.deal(7, 3, seed=22)
+        svc_a = tpke.Tpke(pub_a)
+        ct = svc_a.encrypt(b"group-a")
+        dss = [svc_a.dec_share(s, ct) for s in shares_a]
+        coin = CommonCoin(pub_b)
+        cid = b"epoch|0"
+        css = [coin.share(s, cid) for s in shares_b]
+        # corrupt one share in each group
+        dss[1] = tpke.DhShare(dss[1].index, dss[1].d, dss[1].e, dss[1].z + 1)
+        css[4] = tpke.DhShare(css[4].index, css[4].d + 1, css[4].e, css[4].z)
+
+        ga = (pub_a, ct.c1, dss, svc_a.context(ct))
+        pub_c, base_c, ctx_c = coin.group_params(cid)
+        gb = (pub_c, base_c, css, ctx_c)
+        combined = tpke.verify_share_groups(
+            [(ga[0], ga[1], ga[2], ga[3]), (gb[0], gb[1], gb[2], gb[3])],
+            backend=backend,
+        )
+        singles = [
+            tpke.verify_shares(ga[0], ga[1], ga[2], ga[3], backend="cpu"),
+            tpke.verify_shares(gb[0], gb[1], gb[2], gb[3], backend="cpu"),
+        ]
+        assert combined == singles
+        assert combined[0] == [True, False, True, True]
+        assert combined[1][4] is False and sum(combined[1]) == 6
+
+
+class TestSharePool:
+    def test_deferred_verdicts_flow(self):
+        pub, shares = tpke.deal(4, 2, seed=23)
+        svc = tpke.Tpke(pub)
+        ct = svc.encrypt(b"pool")
+        pool = tpke.SharePool(2)
+        for i, s in enumerate(shares[:3]):
+            assert pool.add(f"n{i}", svc.dec_share(s, ct))
+        assert len(pool) == 3
+        assert pool.ready() is None  # nothing verified yet
+        senders, shs = pool.collect_pending()
+        ok = svc.verify_dec_shares(ct, shs)
+        pool.apply_verdicts(senders, ok)
+        valid = pool.ready()
+        assert valid is not None and len({v.index for v in valid}) >= 2
+        # burned sender cannot resubmit after a bad verdict
+        pool2 = tpke.SharePool(2)
+        bad = tpke.DhShare(1, 2, 3, 4)
+        pool2.add("evil", bad)
+        s2, sh2 = pool2.collect_pending()
+        pool2.apply_verdicts(s2, [False])
+        assert not pool2.add("evil", svc.dec_share(shares[0], ct))
+
+    def test_try_verified_compat(self):
+        pub, shares = tpke.deal(4, 2, seed=24)
+        svc = tpke.Tpke(pub)
+        ct = svc.encrypt(b"compat")
+        pool = tpke.SharePool(2)
+        pool.add("a", svc.dec_share(shares[0], ct))
+        assert pool.try_verified(lambda s: svc.verify_dec_shares(ct, s)) is None
+        pool.add("b", svc.dec_share(shares[1], ct))
+        valid = pool.try_verified(lambda s: svc.verify_dec_shares(ct, s))
+        assert valid is not None and len(valid) == 2
+
+
+class TestHubBatching:
+    def test_branch_groups_agree_with_singles(self):
+        crypto = BatchCrypto("cpu", 8, 2, 4)
+        hub = CryptoHub(crypto)
+        rng = np.random.default_rng(31)
+        shards = rng.integers(0, 256, size=(3, 8, 64), dtype=np.uint8)
+        trees = crypto.merkle.build_batch(shards)
+        results = {}
+        items = []
+        for t_i, t in enumerate(trees):
+            for j in range(8):
+                leaf = shards[t_i, j].tobytes()
+                if t_i == 1 and j == 3:
+                    leaf = b"\xff" + leaf[1:]  # corrupt
+                items.append(
+                    (
+                        t.root,
+                        leaf,
+                        tuple(t.branch(j)),
+                        j,
+                        lambda ok, key=(t_i, j): results.__setitem__(key, ok),
+                    )
+                )
+        hub._run_branches(items)
+        for t_i, t in enumerate(trees):
+            for j in range(8):
+                single = crypto.merkle.verify_branch(
+                    t.root,
+                    shards[t_i, j].tobytes()
+                    if (t_i, j) != (1, 3)
+                    else b"\xff" + shards[t_i, j].tobytes()[1:],
+                    t.branch(j),
+                    j,
+                )
+                assert results[(t_i, j)] == single
+        assert results[(1, 3)] is False
+        assert sum(results.values()) == 23
+
+    def test_epoch_crypto_goes_through_hub_in_few_dispatches(self):
+        """A full N=8 HBBFT epoch: every branch verify, decode and
+        share verify rides the hub; total batched dispatches stay far
+        below the per-message count (~N^2 branch + ~2N share singles)."""
+        from tests.test_honeybadger import (
+            assert_identical_batches,
+            make_hb_network,
+            push_txs,
+        )
+
+        cfg, net, nodes = make_hb_network(8, batch_size=16)
+        push_txs(nodes, 16)
+        for hb in nodes.values():
+            hb.start_epoch()
+        net.run()
+        assert_identical_batches(nodes)
+        for hb in nodes.values():
+            st = hb.hub.stats()
+            # the work actually went through the hub...
+            assert st["branch_items"] >= 8 * (8 - 2)  # >= n-f echoes/instance... at least one instance's quorum
+            assert st["share_items"] >= 8  # coins + dec shares
+            assert st["decode_items"] >= 1
+            # ...in batched dispatches, not one per item
+            assert st["dispatches"] < st["branch_items"] + st["share_items"]
+            assert st["dispatches"] <= 120, st
+
+
+class TestHubLiveness:
+    def test_poisoned_share_burn_and_recovery(self):
+        """A Byzantine dec-share burns through the batched path and the
+        epoch still commits (pool recovers with honest shares)."""
+        from tests.test_honeybadger import (
+            assert_identical_batches,
+            make_hb_network,
+            push_txs,
+        )
+        from cleisthenes_tpu.transport.message import DecSharePayload
+
+        cfg, net, nodes = make_hb_network(4, batch_size=8, seed=3)
+        bad = "node2"
+        orig_post = net.post
+
+        def tamper(sender_id, receiver_id, msg):
+            p = msg.payload
+            if sender_id == bad and isinstance(p, DecSharePayload):
+                from cleisthenes_tpu.transport.message import Message
+
+                msg = Message(
+                    msg.sender_id,
+                    msg.timestamp,
+                    DecSharePayload(
+                        proposer=p.proposer,
+                        epoch=p.epoch,
+                        index=p.index,
+                        d=p.d,
+                        e=p.e,
+                        z=(p.z + 1),
+                    ),
+                    msg.signature,
+                )
+            return orig_post(sender_id, receiver_id, msg)
+
+        net.post = tamper
+        push_txs(nodes, 8)
+        for hb in nodes.values():
+            hb.start_epoch()
+        net.run()
+        assert_identical_batches(nodes)
