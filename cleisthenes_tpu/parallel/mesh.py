@@ -46,7 +46,10 @@ def validate_mesh_shape(mesh_shape) -> Tuple[int, int]:
     CryptoMesh so both layers accept exactly the same shapes).
     Importable without jax."""
     ms = tuple(mesh_shape)
-    if len(ms) != 2 or any((not isinstance(d, int)) or d < 1 for d in ms):
+    # bool is an int subclass: (True, True) must not validate as (1, 1)
+    if len(ms) != 2 or any(
+        isinstance(d, bool) or (not isinstance(d, int)) or d < 1 for d in ms
+    ):
         raise ValueError(
             f"mesh_shape must be two positive ints (v, l), got {mesh_shape!r}"
         )

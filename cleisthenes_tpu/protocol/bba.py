@@ -181,8 +181,16 @@ class BBA:
     def _gated(self, sender: str, payload, rnd: int) -> None:
         """Process current-round messages; park future rounds within
         the horizon (bba/request.go:28-32 pattern, per-round)."""
-        if rnd < self.round or rnd >= MAX_ROUNDS:
+        if rnd < self.round:
             return  # stale: quorums it could join are already closed
+        if rnd >= MAX_ROUNDS:
+            # Liveness cutoff: an instance that somehow reaches round
+            # MAX_ROUNDS can never decide, because the messages that
+            # would let it are dropped here.  Accepted deliberately:
+            # each round ends with probability >= 1/2, so P(reaching
+            # round 1000) ~ 2^-1000 — the bound exists only to cap
+            # state against a pathological/Byzantine round counter.
+            return
         if rnd > self.round:
             if rnd > self.round + ROUND_HORIZON:
                 return

@@ -191,7 +191,11 @@ class RBC:
             return False
         if any(len(b) != 32 for b in payload.branch):
             return False
-        # shards of one root must agree on length (RS needs a matrix)
+        # Shards of one root must agree on length (RS needs a matrix).
+        # _shard_len only ever holds BRANCH-VERIFIED lengths (set in
+        # _handle_val after _check_proof and in _make_echo_cb), so an
+        # unverified Byzantine ECHO cannot poison the expectation and
+        # wedge honest traffic (ADVICE.md round-2 high finding).
         want_len = self._shard_len.get(payload.root_hash)
         if want_len is not None and len(payload.shard) != want_len:
             return False
@@ -216,6 +220,8 @@ class RBC:
             return
         if not self._check_proof(payload):
             return
+        # verified: this length is now the root's authoritative one
+        self._shard_len.setdefault(payload.root_hash, len(payload.shard))
         self._echo_sent = True
         self.out.broadcast(
             RbcPayload(
@@ -249,7 +255,6 @@ class RBC:
         if not self._precheck(payload):
             return
         self._echo_voted.add(sender)  # slot claimed; burns if invalid
-        self._shard_len.setdefault(root, len(payload.shard))
         self._pending_echo.setdefault(root, {})[sender] = payload
         if (
             self._echo_potential(root) >= self.n - self.f
@@ -365,6 +370,13 @@ class RBC:
         def cb(ok: bool) -> None:
             if self.delivered or not ok:
                 return  # invalid: the sender's one slot stays burned
+            # length authority comes only from verified shards; a
+            # verified shard conflicting with the established length
+            # is a Byzantine proposer mixing lengths under one tree —
+            # drop it, RS needs a rectangular matrix
+            want = self._shard_len.setdefault(root, len(p.shard))
+            if len(p.shard) != want:
+                return
             self._echo_senders.setdefault(root, set()).add(sender)
             self._shards.setdefault(root, {})[p.shard_index] = p.shard
 

@@ -230,3 +230,41 @@ def test_rbc_large_payload_roundtrip():
     net.run()
     for rbc in rbcs.values():
         assert rbc.value() == payload
+
+
+def test_rbc_unverified_echo_cannot_poison_shard_length():
+    """ADVICE.md round-2 high finding: a Byzantine member racing one
+    junk ECHO (honest root, wrong-length shard, garbage branch) ahead
+    of the honest traffic must not poison the expected shard length —
+    pre-fix this wedged the victim forever (every honest ECHO and even
+    the VAL failed the length precheck)."""
+    from cleisthenes_tpu.ops.payload import split_payload
+    from cleisthenes_tpu.transport.message import RbcPayload
+
+    cfg, net, rbcs, proposer = make_rbc_network(4)
+    crypto = rbcs[proposer].crypto
+
+    # compute the honest root the proposer will use
+    data = split_payload(PAYLOAD, cfg.data_shards)
+    shards = crypto.erasure.encode(data)
+    tree = crypto.merkle.build(shards)
+    honest_len = shards.shape[1]
+    depth = tree.depth
+
+    junk = RbcPayload(
+        type=RbcType.ECHO,
+        proposer=proposer,
+        epoch=0,
+        root_hash=tree.root,
+        branch=tuple(bytes(32) for _ in range(depth)),
+        shard=b"\x5a" * (honest_len + 7),  # wrong length
+        shard_index=0,
+    )
+    # attacker's ECHO lands at every honest node FIRST
+    for victim in rbcs.values():
+        victim.handle_message("node1", junk)
+
+    rbcs[proposer].propose(PAYLOAD)
+    net.run()
+    for node_id, rbc in rbcs.items():
+        assert rbc.value() == PAYLOAD, f"{node_id} wedged by poisoned len"
