@@ -52,10 +52,23 @@ class ChannelEndpoint:
         self, node_id: str, handler: Handler, auth: Authenticator
     ) -> None:
         self.node_id = node_id
-        self.handler = handler
         self.auth = auth
         self.delivered = 0
         self.rejected = 0  # failed MAC verification
+        self.bind(handler)
+
+    def bind(self, handler: Handler) -> None:
+        """(Re)bind the handler, caching its optional transport hooks:
+        ``flush_outbound`` (drain coalescing buffers after a handler
+        turn) and ``on_idle`` (run deferred batched crypto when no
+        inbound traffic is pending)."""
+        self.handler = handler
+        self.flush_outbound: Optional[Callable[[], None]] = getattr(
+            handler, "flush_outbound", None
+        )
+        self.on_idle: Optional[Callable[[], None]] = getattr(
+            handler, "on_idle", None
+        )
 
 
 class ChannelConnection:
@@ -130,7 +143,7 @@ class ChannelNetwork:
         )
 
     def rebind_handler(self, node_id: str, handler: Handler) -> None:
-        self._endpoints[node_id].handler = handler
+        self._endpoints[node_id].bind(handler)
 
     def connect(self, local_id: str, remote_id: str) -> ChannelConnection:
         return ChannelConnection(self, local_id, remote_id)

@@ -147,6 +147,23 @@ class SyncResponsePayload:
     body: bytes
 
 
+@dataclasses.dataclass(frozen=True)
+class BundlePayload:
+    """Several protocol payloads in ONE authenticated envelope.
+
+    HBBFT's per-epoch traffic is O(N^2) broadcast waves where a node
+    emits one small payload per concurrent instance (N ECHOs, N BBA
+    votes, N dec-shares...) to the same receiver within one handler
+    turn.  Bundling them amortizes the envelope + MAC + frame decode
+    to one per (sender, receiver, wave) instead of one per payload —
+    the message-coalescing lever the reference never needed at its
+    unimplemented scale (its cost model: docs/HONEYBADGER-EN.md:93-96).
+    Nesting is rejected at both encode and decode.
+    """
+
+    items: Tuple["Payload", ...]
+
+
 Payload = Union[
     RbcPayload,
     BbaPayload,
@@ -154,6 +171,7 @@ Payload = Union[
     DecSharePayload,
     SyncRequestPayload,
     SyncResponsePayload,
+    BundlePayload,
 ]
 
 # oneof discriminants (reference message.proto:18-22 has rbc=3, bba=4;
@@ -164,6 +182,11 @@ _KIND_COIN = 5
 _KIND_DEC = 6
 _KIND_SYNC_REQ = 7
 _KIND_SYNC_RESP = 8
+_KIND_BUNDLE = 9
+
+# DoS bound on sub-payloads per bundle (each item is >= 2 bytes on the
+# wire, and the frame itself is capped by MAX_FIELD_BYTES)
+MAX_BUNDLE_ITEMS = 1 << 20
 
 
 @dataclasses.dataclass(frozen=True)
@@ -301,6 +324,17 @@ def _encode_payload(p: Payload) -> Tuple[int, bytes]:
         out.append(struct.pack(">Q", p.epoch))
         _pack_bytes(out, p.body)
         return _KIND_SYNC_RESP, b"".join(out)
+    if isinstance(p, BundlePayload):
+        if len(p.items) > MAX_BUNDLE_ITEMS:
+            raise ValueError(f"bundle of {len(p.items)} items exceeds cap")
+        out.append(struct.pack(">I", len(p.items)))
+        for item in p.items:
+            kind, body = _encode_payload(item)
+            if kind == _KIND_BUNDLE:
+                raise ValueError("nested bundles are not allowed")
+            out.append(struct.pack(">B", kind))
+            _pack_bytes(out, body)
+        return _KIND_BUNDLE, b"".join(out)
     raise TypeError(f"unknown payload type {type(p)!r}")
 
 
@@ -360,6 +394,17 @@ def _decode_payload_inner(r: _Reader, kind: int) -> Payload:
         return SyncRequestPayload(epoch=r.u64())
     if kind == _KIND_SYNC_RESP:
         return SyncResponsePayload(epoch=r.u64(), body=r.bytes_())
+    if kind == _KIND_BUNDLE:
+        count = r.u32()
+        if count > MAX_BUNDLE_ITEMS:
+            raise ValueError(f"bundle count {count} exceeds cap")
+        items = []
+        for _ in range(count):
+            k = r.u8()
+            if k == _KIND_BUNDLE:
+                raise ValueError("nested bundles are not allowed")
+            items.append(_decode_payload(k, r.bytes_()))
+        return BundlePayload(items=tuple(items))
     raise ValueError(f"unknown payload kind {kind}")
 
 
@@ -416,6 +461,7 @@ __all__ = [
     "DecSharePayload",
     "SyncRequestPayload",
     "SyncResponsePayload",
+    "BundlePayload",
     "RbcType",
     "BbaType",
     "encode_message",
