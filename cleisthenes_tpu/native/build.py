@@ -1,4 +1,10 @@
-"""On-demand compilation + ctypes loading of the native kernels."""
+"""On-demand compilation + ctypes loading of the native kernels.
+
+Each kernel source compiles to a shared library cached by source hash
+(rebuilds on change, races benignly via atomic rename); loading is
+attempted once per process and failure degrades to the pure-python /
+XLA paths, never to an exception.
+"""
 
 from __future__ import annotations
 
@@ -8,70 +14,96 @@ import os
 import subprocess
 import tempfile
 from pathlib import Path
-from typing import Optional
+from typing import Callable, Dict, Optional
 
-_SRC = Path(__file__).with_name("gf256.cpp")
-_LIB_CACHE: Optional[ctypes.CDLL] = None
-_LOAD_FAILED = False
+_DIR = Path(__file__).parent
+_LIBS: Dict[str, Optional[ctypes.CDLL]] = {}
 
 
-def _cache_path() -> Path:
+def _cache_path(src: Path) -> Path:
     """Library path keyed by source hash (rebuilds on source change)."""
-    digest = hashlib.sha256(_SRC.read_bytes()).hexdigest()[:16]
-    name = f"_gf256-{digest}.so"
-    local = _SRC.parent / name
-    if os.access(_SRC.parent, os.W_OK):
-        return local
+    digest = hashlib.sha256(src.read_bytes()).hexdigest()[:16]
+    name = f"_{src.stem}-{digest}.so"
+    if os.access(src.parent, os.W_OK):
+        return src.parent / name
     cache_dir = Path(tempfile.gettempdir()) / "cleisthenes_tpu_native"
     cache_dir.mkdir(parents=True, exist_ok=True)
     return cache_dir / name
 
 
-def _compile(out: Path) -> None:
+def _compile(src: Path, out: Path) -> None:
     # per-process tmp name: concurrent first-time builders must not
     # interleave writes before the atomic rename
     tmp = out.with_suffix(f".tmp{os.getpid()}.so")
     cmd = [
         "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
-        "-funroll-loops", str(_SRC), "-o", str(tmp),
+        "-funroll-loops", str(src), "-o", str(tmp),
     ]
-    subprocess.run(
-        cmd, check=True, capture_output=True, timeout=120
-    )
+    subprocess.run(cmd, check=True, capture_output=True, timeout=120)
     tmp.replace(out)  # atomic: concurrent builders race benignly
 
 
-def load_gf256() -> Optional[ctypes.CDLL]:
-    """The compiled library, or None if unavailable (no toolchain)."""
-    global _LIB_CACHE, _LOAD_FAILED
-    if _LIB_CACHE is not None or _LOAD_FAILED:
-        return _LIB_CACHE
+def _load(name: str, configure: Callable[[ctypes.CDLL], None]):
+    """Compile-if-needed + load + configure + selftest, once per
+    process; returns None forever after the first failure."""
+    if name in _LIBS:
+        return _LIBS[name]
     try:
-        path = _cache_path()
+        src = _DIR / f"{name}.cpp"
+        path = _cache_path(src)
         if not path.exists():
-            _compile(path)
+            _compile(src, path)
         lib = ctypes.CDLL(str(path))
-        lib.gf256_matmul.argtypes = [
-            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
-            ctypes.c_int, ctypes.c_int, ctypes.c_int,
-        ]
-        lib.gf256_matmul_batch.argtypes = [
-            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
-            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
-        ]
-        lib.gf256_selftest.restype = ctypes.c_int
-        rc = lib.gf256_selftest()
-        if rc != 0:
-            raise RuntimeError(f"gf256 selftest failed: {rc}")
-        _LIB_CACHE = lib
+        configure(lib)
+        _LIBS[name] = lib
     except Exception:
-        _LOAD_FAILED = True
-        _LIB_CACHE = None
-    return _LIB_CACHE
+        _LIBS[name] = None
+    return _LIBS[name]
+
+
+def _configure_gf256(lib: ctypes.CDLL) -> None:
+    lib.gf256_matmul.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int,
+    ]
+    lib.gf256_matmul_batch.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+    ]
+    lib.gf256_selftest.restype = ctypes.c_int
+    rc = lib.gf256_selftest()
+    if rc != 0:
+        raise RuntimeError(f"gf256 selftest failed: {rc}")
+
+
+def _configure_modpow(lib: ctypes.CDLL) -> None:
+    lib.modpow256_batch.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.c_int,
+    ]
+    lib.dualpow256_batch.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_int,
+    ]
+    lib.modpow256_selftest.restype = ctypes.c_int
+    rc = lib.modpow256_selftest()
+    if rc != 0:
+        raise RuntimeError(f"modpow256 selftest failed: {rc}")
+
+
+def load_gf256() -> Optional[ctypes.CDLL]:
+    """The GF(2^8) RS kernel library, or None (no toolchain)."""
+    return _load("gf256", _configure_gf256)
+
+
+def load_modpow() -> Optional[ctypes.CDLL]:
+    """The 256-bit Montgomery modexp library, or None."""
+    return _load("modpow256", _configure_modpow)
 
 
 def native_available() -> bool:
     return load_gf256() is not None
 
 
-__all__ = ["load_gf256", "native_available"]
+__all__ = ["load_gf256", "load_modpow", "native_available"]

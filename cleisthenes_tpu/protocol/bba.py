@@ -326,7 +326,9 @@ class BBA:
         r = self._rounds.get(self.round)
         if r is None or r.coin_value is not None:
             return
-        senders, shs = r.coin_shares.collect_pending()
+        senders, shs = r.coin_shares.collect_pending(
+            r.coin_shares.need_more()
+        )
         if not senders:
             return
         pub, base, context = self.coin.group_params(

@@ -49,8 +49,10 @@ from cleisthenes_tpu.ops.tpke import (
 from cleisthenes_tpu.protocol.acs import ACS
 from cleisthenes_tpu.utils.log import NodeLogger
 from cleisthenes_tpu.utils.metrics import Metrics
+from cleisthenes_tpu.transport.broadcast import CoalescingBroadcaster
 from cleisthenes_tpu.transport.message import (
     BbaPayload,
+    BundlePayload,
     CoinPayload,
     DecSharePayload,
     Message,
@@ -229,8 +231,10 @@ class _EpochState:
 
 
 class _CountingBroadcaster:
-    """Wraps the node's PayloadBroadcaster to keep msgs_out honest
-    (one count per envelope posted)."""
+    """Wraps the node's PayloadBroadcaster to count outbound protocol
+    PAYLOADS (one per logical message per receiver).  Envelope counts
+    live at the transport (ChannelNetwork.messages_posted): with
+    coalescing, a wave's payloads share far fewer envelopes."""
 
     def __init__(self, inner, metrics: Metrics, n_members: int) -> None:
         self._inner = inner
@@ -288,7 +292,17 @@ class HoneyBadger:
         self.on_commit: Optional[Callable[[int, Batch], None]] = None
         self.metrics = Metrics()
         self.log = NodeLogger(node_id, "hb")
-        self.out = _CountingBroadcaster(out, self.metrics, len(self.members))
+        # Outbound path: protocol payloads -> per-receiver coalescing
+        # buffers -> (at wave boundaries) bundled envelopes on the
+        # inner transport.  In self-draining mode (no transport idle
+        # callback) buffers flush at the end of every entry point; a
+        # transport that calls transport_manages_idle() moves flushing
+        # to its quiescence point for whole-wave bundles.
+        self._coalesce = CoalescingBroadcaster(out, self.members)
+        self._transport_managed = False
+        self.out = _CountingBroadcaster(
+            self._coalesce, self.metrics, len(self.members)
+        )
         self._epochs: Dict[int, _EpochState] = {}
         # production: unpredictable sampling (censorship resistance);
         # seeded: reproducible for tests (config.seed docs)
@@ -337,15 +351,18 @@ class HoneyBadger:
         ``epoch`` defaults to the commit frontier; the pipelining path
         passes ``self.epoch + 1`` to propose ahead (BASELINE config 5).
         """
-        target = self.epoch if epoch is None else epoch
-        es = self._epoch_state(target)
-        if es is None or es.proposed:
-            return
-        es.proposed = True
-        self.metrics.epoch_proposed(target)
-        es.my_txs = self._create_batch()
-        ct = self.tpke.encrypt(serialize_txs(es.my_txs))
-        es.acs.input(serialize_ciphertext(ct))
+        try:
+            target = self.epoch if epoch is None else epoch
+            es = self._epoch_state(target)
+            if es is None or es.proposed:
+                return
+            es.proposed = True
+            self.metrics.epoch_proposed(target)
+            es.my_txs = self._create_batch()
+            ct = self.tpke.encrypt(serialize_txs(es.my_txs))
+            es.acs.input(serialize_ciphertext(ct))
+        finally:
+            self._exit_turn()
 
     def pending_tx_count(self) -> int:
         return len(self.que)
@@ -383,10 +400,49 @@ class HoneyBadger:
                 self.que.push(tx)
         return picked
 
+    # -- transport integration (coalescing + idle hooks) -------------------
+
+    def transport_manages_idle(self) -> None:
+        """Called by a transport that promises to invoke ``on_idle()``
+        at its quiescence points (ChannelNetwork.run's drained-queue
+        phase; SerialDispatcher's empty-mailbox check).  Moves outbound
+        flushing and batched-crypto execution to those points, so one
+        hub flush + one bundle per receiver absorbs an entire message
+        wave."""
+        self._transport_managed = True
+        self.hub.defer = True
+
+    def flush_outbound(self) -> None:
+        self._coalesce.flush()
+
+    def on_idle(self) -> None:
+        """Transport idle callback: run the crypto flush the wave
+        requested (quorum events only record the want in deferred
+        mode), then ship everything it produced."""
+        self.hub.run_deferred()
+        self._coalesce.flush()
+
+    def _exit_turn(self) -> None:
+        """Self-draining mode: every public entry point leaves no
+        buffered outbound behind (transports without idle callbacks
+        would otherwise strand the turn's messages)."""
+        if not self._transport_managed:
+            self._coalesce.flush()
+
     # -- message demux (transport Handler) ---------------------------------
 
     def serve_request(self, msg: Message) -> None:
-        payload = msg.payload
+        try:
+            payload = msg.payload
+            if isinstance(payload, BundlePayload):
+                for item in payload.items:
+                    self._serve_payload(msg.sender_id, item)
+            else:
+                self._serve_payload(msg.sender_id, payload)
+        finally:
+            self._exit_turn()
+
+    def _serve_payload(self, sender_id: str, payload) -> None:
         epoch = getattr(payload, "epoch", None)
         if epoch is None:
             return
@@ -394,10 +450,10 @@ class HoneyBadger:
         # state-sync traffic is deliberately NOT epoch-window gated:
         # it exists exactly for nodes outside the window
         if isinstance(payload, SyncRequestPayload):
-            self._handle_sync_request(msg.sender_id, payload)
+            self._handle_sync_request(sender_id, payload)
             return
         if isinstance(payload, SyncResponsePayload):
-            self._handle_sync_response(msg.sender_id, payload)
+            self._handle_sync_response(sender_id, payload)
             return
         es = self._epoch_state(epoch)
         if es is None:  # outside the sliding window
@@ -406,7 +462,7 @@ class HoneyBadger:
                 self._request_sync()
             return
         if isinstance(payload, DecSharePayload):
-            self._handle_dec_share(es, msg.sender_id, payload)
+            self._handle_dec_share(es, sender_id, payload)
         elif isinstance(payload, (RbcPayload, BbaPayload, CoinPayload)):
             # follow the epoch: a peer is running it, so contribute our
             # (possibly empty) proposal too — every correct node must
@@ -417,7 +473,7 @@ class HoneyBadger:
                 and not es.proposed
             ):
                 self.start_epoch()
-            es.acs.handle_message(msg.sender_id, payload)
+            es.acs.handle_message(sender_id, payload)
 
     def _epoch_state(self, epoch: int) -> Optional[_EpochState]:
         if not (
@@ -529,7 +585,7 @@ class HoneyBadger:
                 pool = es.dec_shares.get(proposer)
                 if pool is None:
                     continue
-                senders, shs = pool.collect_pending()
+                senders, shs = pool.collect_pending(pool.need_more())
                 if not senders:
                     continue
                 shares.append(
@@ -574,7 +630,10 @@ class HoneyBadger:
         """Ask the roster for the committed batch of our current epoch
         (call after a restart; also fired automatically when peer
         traffic shows we are more than EPOCH_HORIZON behind)."""
-        self._request_sync(force=True)
+        try:
+            self._request_sync(force=True)
+        finally:
+            self._exit_turn()
 
     def _request_sync(self, force: bool = False) -> None:
         if not force and self._last_sync_request == self.epoch:

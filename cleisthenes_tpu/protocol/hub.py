@@ -66,6 +66,15 @@ class CryptoHub:
         # wholesale when HoneyBadger GCs an epoch
         self._clients: Dict[object, List[object]] = {}
         self._flushing = False
+        # Deferred mode (HoneyBadger.transport_manages_idle sets
+        # ``hub.defer = True`` when its transport promises an idle
+        # callback): request_flush only
+        # records the want; the actual flush runs at the transport's
+        # quiescence point, so one flush absorbs the whole message
+        # wave's pending work instead of firing per quorum event —
+        # VERDICT round 2's dispatch-count lever (item 2).
+        self.defer = False
+        self.flush_wanted = False
         # observability (utils.metrics reads these)
         self.flushes = 0
         self.branch_items = 0
@@ -84,15 +93,28 @@ class CryptoHub:
     # -- flushing ----------------------------------------------------------
 
     def request_flush(self) -> None:
-        """Run a flush now unless one is already running (in which case
-        its collection loop will pick the new work up)."""
-        if not self._flushing:
+        """Run a flush now — unless one is already running (its
+        collection loop will pick the new work up) or deferred mode
+        parks the request for the transport's idle callback."""
+        if self._flushing:
+            return
+        if self.defer:
+            self.flush_wanted = True
+            return
+        self.flush()
+
+    def run_deferred(self) -> None:
+        """Idle-callback entry: run the flush the message wave asked
+        for (no-op when nothing requested one)."""
+        if self.flush_wanted and not self._flushing:
+            self.flush_wanted = False
             self.flush()
 
     def flush(self) -> None:
         if self._flushing:
             return
         self._flushing = True
+        self.flush_wanted = False  # any full flush satisfies the want
         self.flushes += 1
         try:
             for _ in range(MAX_FLUSH_ROUNDS):

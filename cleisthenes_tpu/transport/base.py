@@ -112,6 +112,14 @@ class NullAuthenticator(Authenticator):
     def verify(self, msg: Message) -> bool:
         return True
 
+    def sign_wire_many(self, msg: Message, receiver_ids) -> "Dict[str, bytes]":
+        """No MAC, so every receiver's frame is the same bytes object:
+        one encode per broadcast."""
+        from cleisthenes_tpu.transport.message import encode_message
+
+        wire = encode_message(msg)
+        return {rid: wire for rid in receiver_ids}
+
 
 class HmacAuthenticator(Authenticator):
     """HMAC-SHA256 over the envelope with per-ordered-pair keys.

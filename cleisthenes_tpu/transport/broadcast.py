@@ -15,9 +15,9 @@ deterministic scheduler in charge of *all* message interleavings.
 from __future__ import annotations
 
 import time
-from typing import List, Protocol, Sequence, runtime_checkable
+from typing import Dict, List, Protocol, Sequence, runtime_checkable
 
-from cleisthenes_tpu.transport.message import Message, Payload
+from cleisthenes_tpu.transport.message import BundlePayload, Message, Payload
 
 
 @runtime_checkable
@@ -45,12 +45,89 @@ class ChannelBroadcaster:
         )
 
     def broadcast(self, payload: Payload) -> None:
-        msg = self._wrap(payload)
-        for member in self._members:
-            self._network.post(self._node_id, member, msg)
+        self._network.post_many(
+            self._node_id, self._members, self._wrap(payload)
+        )
 
     def send_to(self, member_id: str, payload: Payload) -> None:
         self._network.post(self._node_id, member_id, self._wrap(payload))
 
 
-__all__ = ["PayloadBroadcaster", "ChannelBroadcaster"]
+class CoalescingBroadcaster:
+    """Per-receiver outbound buffering in front of any PayloadBroadcaster.
+
+    HBBFT's traffic is O(N^2) broadcast waves of tiny payloads: within
+    one protocol turn a node emits one ECHO/READY/BVAL/AUX/coin/share
+    per concurrent instance, all to the same N receivers.  Buffering
+    them and flushing ONE ``BundlePayload`` envelope per receiver per
+    wave amortizes the envelope encode + MAC + frame decode + verify to
+    one per (sender, receiver, wave) instead of one per payload — the
+    coalescing lever VERDICT round 2 identified as the wall between the
+    N=16 measurement and the BASELINE N=64/128 metric (the reference's
+    per-message cost model: docs/HONEYBADGER-EN.md:93-96).
+
+    ``flush()`` is called by the owner at wave boundaries (a transport
+    idle callback, or the end of a handler turn).  When every buffered
+    payload since the last flush was a broadcast, all receivers' bundles
+    are byte-identical and the flush takes the inner broadcaster's
+    broadcast fast path (one envelope encode, per-receiver MACs only —
+    transport.base.Authenticator.sign_wire_many).
+    """
+
+    def __init__(self, inner, member_ids: Sequence[str]) -> None:
+        self._inner = inner
+        self._members: List[str] = sorted(member_ids)
+        self._buffers: Dict[str, List[Payload]] = {
+            m: [] for m in self._members
+        }
+        self._dirty = False
+        self._broadcast_only = True  # no send_to since last flush
+        self.bundles_flushed = 0
+        self.payloads_buffered = 0
+
+    def broadcast(self, payload: Payload) -> None:
+        for m in self._members:
+            self._buffers[m].append(payload)
+        self.payloads_buffered += len(self._members)
+        self._dirty = True
+
+    def send_to(self, member_id: str, payload: Payload) -> None:
+        buf = self._buffers.get(member_id)
+        if buf is None:  # not a roster member: pass through untouched
+            self._inner.send_to(member_id, payload)
+            return
+        buf.append(payload)
+        self.payloads_buffered += 1
+        self._dirty = True
+        self._broadcast_only = False
+
+    @staticmethod
+    def _fold(buf: List[Payload]) -> Payload:
+        return buf[0] if len(buf) == 1 else BundlePayload(items=tuple(buf))
+
+    def flush(self) -> None:
+        if not self._dirty:
+            return
+        self._dirty = False
+        broadcast_only = self._broadcast_only
+        self._broadcast_only = True
+        if broadcast_only:
+            # identical buffers by construction: one envelope for all
+            first = self._buffers[self._members[0]]
+            if first:
+                folded = self._fold(first)
+                for m in self._members:
+                    self._buffers[m] = []
+                self.bundles_flushed += len(self._members)
+                self._inner.broadcast(folded)
+            return
+        for m in self._members:
+            buf = self._buffers[m]
+            if not buf:
+                continue
+            self._buffers[m] = []
+            self.bundles_flushed += 1
+            self._inner.send_to(m, self._fold(buf))
+
+
+__all__ = ["PayloadBroadcaster", "ChannelBroadcaster", "CoalescingBroadcaster"]

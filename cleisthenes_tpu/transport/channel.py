@@ -69,6 +69,12 @@ class ChannelEndpoint:
         self.on_idle: Optional[Callable[[], None]] = getattr(
             handler, "on_idle", None
         )
+        # ChannelNetwork.run() commits to calling on_idle at every
+        # quiescence point, so the handler may defer crypto flushes
+        # and outbound bundling to those points (whole-wave batching)
+        notify = getattr(handler, "transport_manages_idle", None)
+        if self.on_idle is not None and callable(notify):
+            notify()
 
 
 class ChannelConnection:
@@ -178,11 +184,34 @@ class ChannelNetwork:
         if len(self._pending) >= self._queue_capacity:
             raise OverflowError("channel network queue full")
         ep = self._endpoints.get(sender_id)
-        signed = ep.auth.sign(msg, receiver_id) if ep is not None else msg
-        wire = encode_message(signed)
+        if ep is None:
+            wire = encode_message(msg)
+        else:  # sign_wire_many encodes the envelope exactly once
+            wire = ep.auth.sign_wire_many(msg, [receiver_id])[receiver_id]
         self.messages_posted += 1
         self.bytes_posted += len(wire)
         self._pending.append((sender_id, receiver_id, wire, False))
+
+    def post_many(
+        self, sender_id: str, receiver_ids, msg: Message
+    ) -> None:
+        """Broadcast enqueue: ONE payload encode for the whole receiver
+        set via the authenticator's sign_wire_many fast path (pairwise
+        MACs differ per receiver; the envelope bytes do not)."""
+        if sender_id in self._crashed:
+            return
+        ep = self._endpoints.get(sender_id)
+        if ep is None:
+            for rid in receiver_ids:
+                self.post(sender_id, rid, msg)
+            return
+        frames = ep.auth.sign_wire_many(msg, receiver_ids)
+        for rid, wire in frames.items():
+            if len(self._pending) >= self._queue_capacity:
+                raise OverflowError("channel network queue full")
+            self.messages_posted += 1
+            self.bytes_posted += len(wire)
+            self._pending.append((sender_id, rid, wire, False))
 
     def pending_count(self) -> int:
         return len(self._pending)
@@ -242,19 +271,41 @@ class ChannelNetwork:
             return True
         return False
 
+    def _idle_phase(self) -> None:
+        """The pending queue drained: give every live endpoint its idle
+        callback (deferred batched crypto + outbound bundle flush).
+        Deterministic order — endpoints fire sorted by node id."""
+        for node_id in sorted(self._endpoints):
+            if node_id in self._crashed:
+                continue
+            ep = self._endpoints[node_id]
+            if ep.on_idle is not None:
+                ep.on_idle()
+            elif ep.flush_outbound is not None:
+                ep.flush_outbound()
+
     def run(
         self, max_steps: int = 10_000_000, deadline_s: Optional[float] = None
     ) -> int:
         """Deliver until quiescent (handlers may enqueue more while we
-        drain).  Returns the number of messages delivered."""
+        drain).  Returns the number of messages delivered.
+
+        Quiescence is two-level: when the pending queue drains, every
+        endpoint gets its idle callback (running deferred crypto and
+        flushing coalesced bundles); only when a full idle phase
+        produces no new traffic is the network done.
+        """
         t0 = time.monotonic()
         steps = 0
         while steps < max_steps:
             if deadline_s is not None and time.monotonic() - t0 > deadline_s:
                 break
-            if not self.step():
+            if self.step():
+                steps += 1
+                continue
+            self._idle_phase()
+            if not self._pending:
                 break
-            steps += 1
         return steps
 
 

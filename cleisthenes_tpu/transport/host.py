@@ -48,6 +48,7 @@ class SerialDispatcher:
     def __init__(self, name: str = "dispatch") -> None:
         self._q: "queue.Queue" = queue.Queue()
         self._handler = None
+        self._on_idle = None
         self._thread = threading.Thread(
             target=self._loop, name=name, daemon=True
         )
@@ -56,6 +57,13 @@ class SerialDispatcher:
 
     def bind(self, handler) -> None:
         self._handler = handler
+        # the dispatcher's empty-mailbox check is a real quiescence
+        # point (all queued work processed), so handlers that batch
+        # crypto/outbound by wave get their idle callback here
+        self._on_idle = getattr(handler, "on_idle", None)
+        notify = getattr(handler, "transport_manages_idle", None)
+        if self._on_idle is not None and callable(notify):
+            notify()
 
     # transport Handler interface: called from gRPC reader threads
     def serve_request(self, msg: Message) -> None:
@@ -105,6 +113,16 @@ class SerialDispatcher:
                 import traceback
 
                 traceback.print_exc()
+            if self._on_idle is not None and self._q.empty():
+                # mailbox drained: wave boundary (a racing producer
+                # just means an extra flush later — never a lost one,
+                # since its message re-triggers this check)
+                try:
+                    self._on_idle()
+                except Exception:
+                    import traceback
+
+                    traceback.print_exc()
 
     def stop(self) -> None:
         self._stopped.set()

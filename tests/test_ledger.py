@@ -183,7 +183,12 @@ def test_lagging_restart_catches_up_via_state_sync(tmp_path):
     net2 = ChannelNetwork()
     for i in ids[:3]:
         net2.join(i, nodes[i], None)
-        nodes[i].out._inner._network = net2  # re-point broadcasters
+        # re-point the node's transport-level broadcaster (walk the
+        # counting + coalescing wrappers down to the ChannelBroadcaster)
+        inner = nodes[i].out
+        while not hasattr(inner, "_network"):
+            inner = inner._inner
+        inner._network = net2
     fresh = build(net2, "node3")
     assert fresh.epoch == 0
     fresh.request_sync()
