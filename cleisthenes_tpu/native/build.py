@@ -37,7 +37,7 @@ def _compile(src: Path, out: Path) -> None:
     tmp = out.with_suffix(f".tmp{os.getpid()}.so")
     cmd = [
         "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
-        "-funroll-loops", str(src), "-o", str(tmp),
+        "-funroll-loops", "-pthread", str(src), "-o", str(tmp),
     ]
     subprocess.run(cmd, check=True, capture_output=True, timeout=120)
     tmp.replace(out)  # atomic: concurrent builders race benignly

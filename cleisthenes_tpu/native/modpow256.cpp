@@ -19,6 +19,8 @@
 
 #include <cstdint>
 #include <cstring>
+#include <thread>
+#include <vector>
 
 typedef uint64_t u64;
 typedef unsigned __int128 u128;
@@ -166,6 +168,31 @@ void dual_pow(const Ctx& c, const u64 u1[4], const u64 e1[4],
     mont_mul(c, acc, one, out);
 }
 
+// Independent exponentiations parallelize trivially; threading kicks
+// in above a batch-size floor where spawn cost (~20 us/thread)
+// amortizes.  ctypes releases the GIL for the whole call.
+constexpr int kParallelFloor = 64;
+
+template <typename F>
+void run_batch(int b, F&& body) {
+    unsigned hw = std::thread::hardware_concurrency();
+    int threads = (int)(hw ? hw : 1);
+    if (threads > 16) threads = 16;
+    if (b < kParallelFloor || threads <= 1) {
+        body(0, b);
+        return;
+    }
+    if (threads > b) threads = b;
+    std::vector<std::thread> pool;
+    int chunk = (b + threads - 1) / threads;
+    for (int t = 0; t < threads; ++t) {
+        int lo = t * chunk, hi = lo + chunk < b ? lo + chunk : b;
+        if (lo >= hi) break;
+        pool.emplace_back([&body, lo, hi] { body(lo, hi); });
+    }
+    for (auto& th : pool) th.join();
+}
+
 }  // namespace
 
 extern "C" {
@@ -178,13 +205,15 @@ void modpow256_batch(const uint8_t* bases, const uint8_t* exps,
     u64 n[4];
     memcpy(n, mod, 32);
     ctx_init(c, n);
-    for (int i = 0; i < b; ++i) {
-        u64 base[4], e[4], r[4];
-        memcpy(base, bases + 32 * i, 32);
-        memcpy(e, exps + 32 * i, 32);
-        mod_pow(c, base, e, r);
-        memcpy(out + 32 * i, r, 32);
-    }
+    run_batch(b, [&](int lo, int hi) {
+        for (int i = lo; i < hi; ++i) {
+            u64 base[4], e[4], r[4];
+            memcpy(base, bases + 32 * i, 32);
+            memcpy(e, exps + 32 * i, 32);
+            mod_pow(c, base, e, r);
+            memcpy(out + 32 * i, r, 32);
+        }
+    });
 }
 
 void dualpow256_batch(const uint8_t* u1, const uint8_t* e1,
@@ -194,15 +223,17 @@ void dualpow256_batch(const uint8_t* u1, const uint8_t* e1,
     u64 n[4];
     memcpy(n, mod, 32);
     ctx_init(c, n);
-    for (int i = 0; i < b; ++i) {
-        u64 a[4], x[4], bb[4], y[4], r[4];
-        memcpy(a, u1 + 32 * i, 32);
-        memcpy(x, e1 + 32 * i, 32);
-        memcpy(bb, u2 + 32 * i, 32);
-        memcpy(y, e2 + 32 * i, 32);
-        dual_pow(c, a, x, bb, y, r);
-        memcpy(out + 32 * i, r, 32);
-    }
+    run_batch(b, [&](int lo, int hi) {
+        for (int i = lo; i < hi; ++i) {
+            u64 a[4], x[4], bb[4], y[4], r[4];
+            memcpy(a, u1 + 32 * i, 32);
+            memcpy(x, e1 + 32 * i, 32);
+            memcpy(bb, u2 + 32 * i, 32);
+            memcpy(y, e2 + 32 * i, 32);
+            dual_pow(c, a, x, bb, y, r);
+            memcpy(out + 32 * i, r, 32);
+        }
+    });
 }
 
 int modpow256_selftest() {

@@ -375,6 +375,27 @@ class SharePool:
             return None
         return list(by_index.values())
 
+    def optimistic_subset(self) -> Optional[List[DhShare]]:
+        """Threshold index-distinct shares counting UNVERIFIED ones
+        (verified preferred, then pending by sender order), or None.
+
+        For consumers whose combined output is self-authenticating
+        (TPKE: the ciphertext tag checks the combined KEM value), an
+        optimistic combine on this subset replaces per-share CP
+        verification in the honest case entirely; a tag failure means
+        some selected share was invalid, and the caller falls back to
+        the verified path, which burns the culprit.  NOT safe for the
+        common coin — its combined value has no independent check."""
+        by_index: Dict[int, DhShare] = {}
+        for share in self._verified.values():
+            by_index.setdefault(share.index, share)
+        for sender in sorted(self._pending):
+            share = self._pending[sender]
+            by_index.setdefault(share.index, share)
+        if len(by_index) < self.threshold:
+            return None
+        return list(by_index.values())
+
     def try_verified(self, verify_fn) -> Optional[List[DhShare]]:
         """Self-contained threshold check: if >= threshold shares are
         pooled, batch-verify the pending ones (``verify_fn(shares) ->

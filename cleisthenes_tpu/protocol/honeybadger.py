@@ -214,6 +214,7 @@ class _EpochState:
         "ciphertexts",
         "dec_shares",
         "decrypted",
+        "opt_failed",
         "committed",
     )
 
@@ -227,6 +228,9 @@ class _EpochState:
         self.dec_shares: Dict[str, SharePool] = {}
         # proposer -> tx list, or None = deterministically excluded
         self.decrypted: Dict[str, Optional[List[bytes]]] = {}
+        # proposers whose optimistic (unverified-subset) combine hit a
+        # bad tag: their shares take the CP-verified path instead
+        self.opt_failed: Set[str] = set()
         self.committed = False
 
 
@@ -560,16 +564,36 @@ class HoneyBadger:
     def _try_decrypt(
         self, epoch: int, es: _EpochState, proposer: str
     ) -> None:
-        """Threshold reached -> hub flush: this proposer's shares, every
-        OTHER proposer's pooled shares, the concurrent BBA coins and
-        any pending RBC work all verify in the same batched dispatches
-        (the "TPKE-share-verify ops/sec" BASELINE metric)."""
+        """Threshold reached: optimistic combine first — the ciphertext
+        tag authenticates the combined KEM value, so in the honest case
+        NO per-share CP verification runs at all (it replaces 2(f+1)
+        dual-exponentiations per proposer).  A bad tag means a selected
+        share was invalid: flag the proposer onto the CP-verified hub
+        path, which burns the culprit and combines valid shares."""
         if es.output is None or proposer in es.decrypted:
             return
-        if es.ciphertexts.get(proposer) is None:
+        ct = es.ciphertexts.get(proposer)
+        if ct is None:
             return
         pool = es.dec_shares.get(proposer)
         if pool is None or len(pool) < self.keys.tpke_pub.threshold:
+            return
+        if proposer not in es.opt_failed:
+            subset = pool.optimistic_subset()
+            if subset is None:
+                return
+            try:
+                plain = self.tpke.combine(ct, subset)
+            except ValueError:  # bad tag: an invalid share slipped in
+                es.opt_failed.add(proposer)
+                self.hub.request_flush()
+                return
+            try:
+                es.decrypted[proposer] = deserialize_txs(plain)
+            except ValueError:
+                # authentic plaintext, malformed framing: the
+                # proposer's own doing, identical at every node
+                es.decrypted[proposer] = None
             return
         self.hub.request_flush()
 
@@ -581,6 +605,10 @@ class HoneyBadger:
                 continue
             for proposer, ct in es.ciphertexts.items():
                 if proposer in es.decrypted:
+                    continue
+                if proposer not in es.opt_failed:
+                    # honest path: the optimistic combine needs no CP
+                    # verification; don't burn modexps on its shares
                     continue
                 pool = es.dec_shares.get(proposer)
                 if pool is None:

@@ -67,6 +67,26 @@ class Broadcaster(Protocol):
     def send_to(self, conn_id: str, msg: Message) -> None: ...
 
 
+def wire_idle_hooks(handler):
+    """The transport-manages-idle handshake, in one place.
+
+    Returns ``(flush_outbound, on_idle)`` — the handler's optional
+    transport hooks (None when absent) — and, IFF the handler exposes
+    ``on_idle``, notifies it via ``transport_manages_idle()`` that this
+    transport COMMITS to calling ``on_idle`` at every quiescence point.
+    The promise is load-bearing: a notified handler defers batched
+    crypto and outbound bundling to those callbacks, so a transport
+    must only call this if it will deliver them (ChannelNetwork.run's
+    idle phase; SerialDispatcher's empty-mailbox check).
+    """
+    flush_outbound = getattr(handler, "flush_outbound", None)
+    on_idle = getattr(handler, "on_idle", None)
+    notify = getattr(handler, "transport_manages_idle", None)
+    if on_idle is not None and callable(notify):
+        notify()
+    return flush_outbound, on_idle
+
+
 # ---------------------------------------------------------------------------
 # Authentication (the implemented version of conn.go:134-137's TODO)
 # ---------------------------------------------------------------------------
@@ -86,6 +106,12 @@ class Authenticator(abc.ABC):
 
     @abc.abstractmethod
     def verify(self, msg: Message) -> bool: ...
+
+    def verify_wire(self, msg: Message, signing_prefix: bytes) -> bool:
+        """Verify using the frame's own signing-bytes prefix (from
+        transport.message.decode_frame) — MAC backends override to
+        skip the payload re-encode that ``verify`` must do."""
+        return self.verify(msg)
 
     def sign_wire_many(self, msg: Message, receiver_ids) -> "Dict[str, bytes]":
         """receiver_id -> complete wire frame, for broadcasts.
@@ -204,6 +230,27 @@ class HmacAuthenticator(Authenticator):
         if key is None:  # not a roster member we share a key with
             return False
         want = hmac.new(key, signing_bytes(msg), hashlib.sha256).digest()
+        return hmac.compare_digest(want, msg.signature)
+
+    def verify_wire(self, msg: Message, signing_prefix: bytes) -> bool:
+        """MAC the frame's signing prefix directly.
+
+        The security argument: the MAC binds the RECEIVED bytes, and
+        only the two pair-key holders can produce a valid MAC over any
+        byte string, so acceptance here implies the claimed sender
+        authenticated exactly these bytes.  This is strictly
+        byte-binding — stronger than re-encode-verify for attackers
+        without the key.  Where it can differ from ``verify``: a frame
+        whose payload was encoded NON-canonically (e.g. an int field
+        with a leading zero byte) yet MAC'd by the key holder itself
+        would pass here and fail re-encode-verify — but our encoder is
+        canonical, so honest peers never emit such frames, and a
+        Byzantine key holder gains nothing it couldn't send anyway
+        (no component deduplicates or compares raw frame bytes)."""
+        key = self._key_with(msg.sender_id)
+        if key is None:
+            return False
+        want = hmac.new(key, signing_prefix, hashlib.sha256).digest()
         return hmac.compare_digest(want, msg.signature)
 
     def sign_wire_many(self, msg: Message, receiver_ids) -> "Dict[str, bytes]":

@@ -106,6 +106,10 @@ class CoalescingBroadcaster:
         return buf[0] if len(buf) == 1 else BundlePayload(items=tuple(buf))
 
     def flush(self) -> None:
+        """Ship every buffered payload.  Exception-safe: a transport
+        failure mid-flush (queue overflow, missing pair key) re-marks
+        the unsent buffers dirty and re-raises, so the next flush
+        retries instead of silently stranding a wave's bundles."""
         if not self._dirty:
             return
         self._dirty = False
@@ -115,19 +119,30 @@ class CoalescingBroadcaster:
             # identical buffers by construction: one envelope for all
             first = self._buffers[self._members[0]]
             if first:
-                folded = self._fold(first)
+                try:
+                    self._inner.broadcast(self._fold(first))
+                except Exception:
+                    self._dirty = True
+                    self._broadcast_only = broadcast_only
+                    raise
                 for m in self._members:
                     self._buffers[m] = []
                 self.bundles_flushed += len(self._members)
-                self._inner.broadcast(folded)
             return
         for m in self._members:
             buf = self._buffers[m]
             if not buf:
                 continue
+            try:
+                self._inner.send_to(m, self._fold(buf))
+            except Exception:
+                # this member's (and any later members') payloads stay
+                # buffered for the retry
+                self._dirty = True
+                self._broadcast_only = False
+                raise
             self._buffers[m] = []
             self.bundles_flushed += 1
-            self._inner.send_to(m, self._fold(buf))
 
 
 __all__ = ["PayloadBroadcaster", "ChannelBroadcaster", "CoalescingBroadcaster"]

@@ -32,7 +32,7 @@ from cleisthenes_tpu.transport.base import (
 )
 from cleisthenes_tpu.transport.message import (
     Message,
-    decode_message,
+    decode_frame,
     encode_message,
 )
 
@@ -58,23 +58,14 @@ class ChannelEndpoint:
         self.bind(handler)
 
     def bind(self, handler: Handler) -> None:
-        """(Re)bind the handler, caching its optional transport hooks:
-        ``flush_outbound`` (drain coalescing buffers after a handler
-        turn) and ``on_idle`` (run deferred batched crypto when no
-        inbound traffic is pending)."""
+        """(Re)bind the handler.  ChannelNetwork.run() delivers the
+        idle-callback promise (wire_idle_hooks) at every quiescence
+        point; callers driving delivery manually with step() must pair
+        it with idle_phase() — see step()."""
         self.handler = handler
-        self.flush_outbound: Optional[Callable[[], None]] = getattr(
-            handler, "flush_outbound", None
-        )
-        self.on_idle: Optional[Callable[[], None]] = getattr(
-            handler, "on_idle", None
-        )
-        # ChannelNetwork.run() commits to calling on_idle at every
-        # quiescence point, so the handler may defer crypto flushes
-        # and outbound bundling to those points (whole-wave batching)
-        notify = getattr(handler, "transport_manages_idle", None)
-        if self.on_idle is not None and callable(notify):
-            notify()
+        from cleisthenes_tpu.transport.base import wire_idle_hooks
+
+        self.flush_outbound, self.on_idle = wire_idle_hooks(handler)
 
 
 class ChannelConnection:
@@ -222,6 +213,13 @@ class ChannelNetwork:
         Delivery order: FIFO without a seed, seeded-uniform-random with
         one — every run with the same seed replays the identical
         interleaving.
+
+        Manual driving contract: handlers joined to this network defer
+        outbound bundles and batched crypto to idle callbacks, so a
+        caller looping ``step()`` directly MUST call ``idle_phase()``
+        whenever ``step()`` returns False (and keep going if new
+        messages appear) — exactly what ``run()`` does — or buffered
+        work strands and the protocol stalls without error.
         """
         while self._pending:
             if self._rng is None:
@@ -258,11 +256,11 @@ class ChannelNetwork:
             if ep is None:
                 continue
             try:
-                msg = decode_message(wire)
+                msg, signing_prefix = decode_frame(wire)
             except ValueError:
                 ep.rejected += 1
                 continue
-            if not ep.auth.verify(msg):
+            if not ep.auth.verify_wire(msg, signing_prefix):
                 # the implemented version of conn.go:134-137's TODO
                 ep.rejected += 1
                 continue
@@ -271,7 +269,7 @@ class ChannelNetwork:
             return True
         return False
 
-    def _idle_phase(self) -> None:
+    def idle_phase(self) -> None:
         """The pending queue drained: give every live endpoint its idle
         callback (deferred batched crypto + outbound bundle flush).
         Deterministic order — endpoints fire sorted by node id."""
@@ -303,7 +301,7 @@ class ChannelNetwork:
             if self.step():
                 steps += 1
                 continue
-            self._idle_phase()
+            self.idle_phase()
             if not self._pending:
                 break
         return steps

@@ -38,7 +38,7 @@ from cleisthenes_tpu.transport.base import (
 )
 from cleisthenes_tpu.transport.message import (
     Message,
-    decode_message,
+    decode_frame,
     encode_message,
 )
 
@@ -170,11 +170,13 @@ class GrpcConnection:
                 if self._closed.is_set():
                     break
                 try:
-                    msg = decode_message(wire)
+                    msg, signing_prefix = decode_frame(wire)
                 except ValueError:
                     self.rejected += 1
                     continue
-                if not self._auth.verify(msg):  # conn.go:134-137, real
+                if not self._auth.verify_wire(  # conn.go:134-137, real
+                    msg, signing_prefix
+                ):
                     self.rejected += 1
                     continue
                 self.delivered += 1

@@ -59,11 +59,10 @@ class SerialDispatcher:
         self._handler = handler
         # the dispatcher's empty-mailbox check is a real quiescence
         # point (all queued work processed), so handlers that batch
-        # crypto/outbound by wave get their idle callback here
-        self._on_idle = getattr(handler, "on_idle", None)
-        notify = getattr(handler, "transport_manages_idle", None)
-        if self._on_idle is not None and callable(notify):
-            notify()
+        # crypto/outbound by wave get their idle callback there
+        from cleisthenes_tpu.transport.base import wire_idle_hooks
+
+        _, self._on_idle = wire_idle_hooks(handler)
 
     # transport Handler interface: called from gRPC reader threads
     def serve_request(self, msg: Message) -> None:

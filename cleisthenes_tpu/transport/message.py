@@ -431,7 +431,14 @@ def encode_message(msg: Message) -> bytes:
     return attach_signature(signing_bytes(msg), msg.signature)
 
 
-def decode_message(data: bytes) -> Message:
+def decode_frame(data: bytes) -> Tuple[Message, bytes]:
+    """Decode a frame into (Message, signing_prefix).
+
+    The wire layout is ``signing_bytes || len(sig) || sig``
+    (attach_signature), so the exact byte string the MAC covers is a
+    PREFIX of the frame — returning it lets authenticators verify
+    without re-encoding the payload (at N=64 the re-encode was ~1/5 of
+    the whole epoch's wall clock)."""
     if len(data) < 6 or data[:4] != _MAGIC:
         raise ValueError("bad magic")
     version, kind = data[4], data[5]
@@ -441,15 +448,23 @@ def decode_message(data: bytes) -> Message:
     sender = r.str_()
     ts = r.f64()
     body = r.bytes_()
+    signing_prefix = data[: 6 + r._o]
     sig = r.bytes_()
     if not r.done():
         raise ValueError("trailing bytes in frame")
-    return Message(
-        sender_id=sender,
-        timestamp=ts,
-        payload=_decode_payload(kind, body),
-        signature=sig,
+    return (
+        Message(
+            sender_id=sender,
+            timestamp=ts,
+            payload=_decode_payload(kind, body),
+            signature=sig,
+        ),
+        signing_prefix,
     )
+
+
+def decode_message(data: bytes) -> Message:
+    return decode_frame(data)[0]
 
 
 __all__ = [
@@ -466,6 +481,7 @@ __all__ = [
     "BbaType",
     "encode_message",
     "decode_message",
+    "decode_frame",
     "signing_bytes",
     "MAX_FIELD_BYTES",
 ]
