@@ -306,15 +306,22 @@ def protocol_section(backend_accel: str, backend_cpu: str, n: int,
 def measure_n512_pipelined(backend: str) -> dict:
     """Multi-epoch crypto plane at N=512/f=170 (BASELINE config 5).
 
-    Per epoch, the protocol's actual device work: RS-encode all N
-    proposals, build the Merkle forest, verify one branch wave
-    (N shards x N receivers is transport-side; the device sees the
-    batched proof check), RS-decode, and the threshold-limited share
-    load 2*N*(f+1) CP proofs (what the live wave-deferred hub
-    dispatches — NOT the naive N^2).  Epochs run back-to-back so epoch
-    e+1's RS/Merkle overlaps epoch e's tail in the same measurement
-    window; reported as per-epoch p50 over P512_EPOCHS.
+    Per epoch, the protocol's actual device work in two stages:
+      A. RS-encode all N proposals, Merkle forest, branch wave check,
+         RS-decode  (the RBC plane)
+      B. the threshold-limited share-verify load 2*N*(f+1) CP proofs
+         (what the live wave-deferred hub dispatches — NOT naive N^2)
+
+    Pipelining: epoch e+1's stage A runs on the caller thread while
+    epoch e's stage B drains on a worker thread — the two-deep
+    software pipeline of BASELINE config 5 ("overlap epoch e+1's RS
+    encode with epoch e's decrypt").  Both stages release the GIL into
+    XLA/native kernels, so the overlap is real on multi-core hosts and
+    on the TPU (device queue vs host-bound verify); a sequential run
+    of the same epochs is measured alongside, and the speedup is
+    reported as ``pipeline_overlap_x`` (~1.0 on a single-core host).
     """
+    import concurrent.futures
     from cleisthenes_tpu.ops.backend import BatchCrypto
     from cleisthenes_tpu.ops.payload import split_payload
     from cleisthenes_tpu.ops import tpke as tpke_mod
@@ -344,7 +351,8 @@ def measure_n512_pipelined(backend: str) -> dict:
     n_share_checks = 2 * n * (f + 1)
     engine_backend = "cpu" if backend == "cpp" else backend
 
-    def one_epoch():
+    def stage_a():
+        """Epoch RBC plane: encode + forest + branch wave + decode."""
         encoded = crypto.erasure.encode_batch(data)
         trees = crypto.merkle.build_batch(encoded)
         roots = np.stack(
@@ -364,6 +372,9 @@ def measure_n512_pipelined(backend: str) -> dict:
         crypto.erasure.decode_batch(
             np.tile(survivor, (n, 1)), encoded[:, survivor, :]
         )
+
+    def stage_b():
+        """Epoch share-verify plane (decrypt + coin verification)."""
         remaining = n_share_checks
         while remaining > 0:
             chunk = min(remaining, SHARE_VERIFY_CHUNK, len(shares) * 8)
@@ -374,14 +385,25 @@ def measure_n512_pipelined(backend: str) -> dict:
             assert all(res)
             remaining -= chunk
 
-    one_epoch()  # warm-up / compile
-    times = []
-    t_all = time.perf_counter()
+    stage_a()
+    stage_b()  # warm-up / compile
+    # sequential reference: epochs strictly one after another
+    t0 = time.perf_counter()
     for _ in range(P512_EPOCHS):
+        stage_a()
+        stage_b()
+    seq_wall = time.perf_counter() - t0
+    # two-deep pipeline: e+1's RBC plane overlaps e's share verify
+    with concurrent.futures.ThreadPoolExecutor(1) as pool:
         t0 = time.perf_counter()
-        one_epoch()
-        times.append(time.perf_counter() - t0)
-    wall = time.perf_counter() - t_all
+        tail = None
+        for _ in range(P512_EPOCHS):
+            stage_a()
+            if tail is not None:
+                tail.result()
+            tail = pool.submit(stage_b)
+        tail.result()
+        pipe_wall = time.perf_counter() - t0
     return {
         "n": n,
         "f": f,
@@ -389,8 +411,12 @@ def measure_n512_pipelined(backend: str) -> dict:
         "epochs": P512_EPOCHS,
         "rs_shards": shards,  # GF(2^8) field cap, same as klauspost's
         "rs_k": k,
-        "epoch_p50_ms": round(statistics.median(times) * 1000.0, 3),
-        "pipeline_wall_ms": round(wall * 1000.0, 3),
+        "epoch_p50_ms": round(pipe_wall / P512_EPOCHS * 1000.0, 3),
+        "pipelined_wall_ms": round(pipe_wall * 1000.0, 3),
+        "sequential_wall_ms": round(seq_wall * 1000.0, 3),
+        "pipeline_overlap_x": round(seq_wall / pipe_wall, 3)
+        if pipe_wall > 0
+        else None,
         "share_checks_per_epoch": n_share_checks,
     }
 
@@ -408,7 +434,13 @@ def run_child() -> None:
     """
     import jax
 
-    platform = jax.devices()[0].platform  # 'axon' (TPU relay) or 'cpu'
+    dev = jax.devices()[0]
+    # the axon relay's PJRT plugin presents real chips as platform
+    # 'tpu' (device_kind e.g. 'TPU v5 lite'); the forced fallback is
+    # platform 'cpu'
+    platform = dev.platform
+    device_kind = getattr(dev, "device_kind", "")
+    on_tpu = platform in ("tpu", "axon")
     cpu_ref = cpu_reference_backend()
     accel_p50 = measure_crypto("tpu")
     cpu_p50 = measure_crypto(cpu_ref)
@@ -418,6 +450,7 @@ def run_child() -> None:
         "unit": "ms",
         "vs_baseline": _vs(cpu_p50 * 1000.0, accel_p50 * 1000.0),
         "platform": platform,
+        "device": device_kind,
         "cpu_reference": cpu_ref,
         "baseline_note": (
             "CPU GF plane uses native C++ kernels when available; "
@@ -425,6 +458,20 @@ def run_child() -> None:
         ),
     }
     for name, pc in PROTO_CONFIGS.items():
+        if name == "protocol_n64" and not on_tpu:
+            # XLA-on-host CPU is a degraded stand-in, not the measured
+            # backend; at N=64 its Montgomery kernels would add ~10min
+            # of fallback noise.  Record the CPU-native numbers and
+            # mark the accelerated side unmeasured.
+            cpu = measure_protocol(cpu_ref, pc["n"], pc["batch"],
+                                   pc["epochs"])
+            out[name] = {
+                "n": pc["n"], "batch": pc["batch"], "cpu": cpu,
+                "tpu": None, "vs_cpu": None,
+                "note": "tpu side skipped: no TPU attached "
+                        "(platform=cpu fallback)",
+            }
+            continue
         out[name] = protocol_section(
             "tpu", cpu_ref, pc["n"], pc["batch"], pc["epochs"]
         )

@@ -1,0 +1,42 @@
+#!/usr/bin/env bash
+# CI gate: the one command that gates the tree.
+#
+# Mirrors the reference's PR pipeline (reference .travis.yml:24-27 +
+# travis/run_on_pull_requests.sh: goimports format gate, `go test -v`,
+# then `go test -race`), translated to this stack:
+#
+#   1. format/syntax gate  — compileall over package + tests (no
+#      third-party formatter is baked into the image; syntax+bytecode
+#      compilation is the deterministic equivalent gate)
+#   2. fast test tier      — pytest minus the multi-minute scale tests
+#   3. race-analog tier    — the seeded deterministic-scheduler suites
+#      (transport/byzantine), this stack's answer to `-race`
+#      (SURVEY.md §5.2: replayable interleavings instead of a dynamic
+#      race detector), plus the real-thread gRPC suite
+#   4. full tier           — everything, including the N=64 slow test
+#      (skipped when CI_FAST=1)
+#
+# Usage:  ./ci.sh          # full gate
+#         CI_FAST=1 ./ci.sh  # pre-push quick gate
+
+set -euo pipefail
+cd "$(dirname "$0")"
+
+echo "== [1/4] syntax gate: compileall"
+python -m compileall -q cleisthenes_tpu tests bench.py __graft_entry__.py
+
+echo "== [2/4] fast tests"
+python -m pytest tests/ -q -m "not slow" -x
+
+echo "== [3/4] race-analog: seeded-scheduler + threaded-transport suites"
+python -m pytest tests/test_transport.py tests/test_byzantine.py \
+    tests/test_grpc.py -q -x
+
+if [[ "${CI_FAST:-0}" == "1" ]]; then
+    echo "== [4/4] skipped (CI_FAST=1)"
+else
+    echo "== [4/4] full suite incl. scale tests"
+    python -m pytest tests/ -q -m slow
+fi
+
+echo "== CI gate PASSED"

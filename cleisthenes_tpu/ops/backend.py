@@ -132,6 +132,28 @@ class BatchCrypto:
         """Backend name for the modexp engine (tpke/coin verify)."""
         return "cpu" if self.backend == "cpp" else self.backend
 
+    def decode_recheck_batch(self, indices, shards):
+        """RBC delivery check: decode + re-encode + Merkle roots
+        (docs/RBC-EN.md:37-39) for a batch of instances.
+
+        Returns ``(data (B, k, L), roots (B, 32) uint8, dispatches)``.
+        The 'tpu' backend fuses the chain into one XLA program when the
+        erasure patterns match (the common case); otherwise — and on
+        the host backends — it is the 3-step sequence."""
+        fused = getattr(self.erasure, "decode_recheck_batch", None)
+        if fused is not None:
+            out = fused(indices, shards)
+            if out is not None:
+                data, roots = out
+                return data, roots, 1
+        data = self.erasure.decode_batch(indices, shards)
+        full = self.erasure.encode_batch(data)
+        trees = self.merkle.build_batch(full)
+        roots = np.stack(
+            [np.frombuffer(t.root, dtype=np.uint8) for t in trees]
+        )
+        return data, roots, 3
+
     def tpke(self, pub):
         """Threshold-decryption service bound to this backend
         (pub: tpke.ThresholdPublicKey)."""

@@ -24,6 +24,7 @@ import hashlib
 from typing import List, Sequence
 
 from cleisthenes_tpu.ops import tpke
+from cleisthenes_tpu.ops.modmath import DEFAULT_GROUP, GroupParams
 from cleisthenes_tpu.ops.tpke import (
     DhShare,
     ThresholdPublicKey,
@@ -31,9 +32,11 @@ from cleisthenes_tpu.ops.tpke import (
 )
 
 
-def coin_base(coin_id: bytes) -> int:
+def coin_base(
+    coin_id: bytes, group: GroupParams = DEFAULT_GROUP
+) -> int:
     """The group element x = H2G(coin_id) whose s-th power is the coin."""
-    return tpke.hash_to_group(b"coin|" + coin_id)
+    return tpke.hash_to_group(b"coin|" + coin_id, group)
 
 
 class CommonCoin:
@@ -45,18 +48,24 @@ class CommonCoin:
         self.pub = pub
         self.backend = backend
         self.mesh = mesh
+        self.group = pub.group  # the key set carries its group
 
     def share(
         self, secret: ThresholdSecretShare, coin_id: bytes
     ) -> DhShare:
-        return tpke.issue_share(secret, coin_base(coin_id), b"coin|" + coin_id)
+        return tpke.issue_share(
+            secret,
+            coin_base(coin_id, self.group),
+            b"coin|" + coin_id,
+            self.group,
+        )
 
     def verify_shares(
         self, coin_id: bytes, shares: Sequence[DhShare]
     ) -> List[bool]:
         return tpke.verify_shares(
             self.pub,
-            coin_base(coin_id),
+            coin_base(coin_id, self.group),
             shares,
             b"coin|" + coin_id,
             self.backend,
@@ -67,14 +76,16 @@ class CommonCoin:
         """(pub, base, context) for this coin — the key the protocol
         hub uses to fold coin-share verification into one cross-
         instance tpke.verify_share_groups dispatch."""
-        return self.pub, coin_base(coin_id), b"coin|" + coin_id
+        return self.pub, coin_base(coin_id, self.group), b"coin|" + coin_id
 
     def combine(self, coin_id: bytes, shares: Sequence[DhShare]) -> int:
         """Full 256-bit coin value from >= f+1 verified shares."""
-        val = tpke.combine_shares(shares, self.pub.threshold)
+        val = tpke.combine_shares(shares, self.pub.threshold, self.group)
         return int.from_bytes(
             hashlib.sha256(
-                b"coinval|" + coin_id + val.to_bytes(32, "big")
+                b"coinval|"
+                + coin_id
+                + val.to_bytes(self.group.nbytes, "big")
             ).digest(),
             "big",
         )

@@ -76,6 +76,25 @@ _decode_kernel_batch = jax.jit(jax.vmap(_decode_kernel, in_axes=(0, 0)))
 _decode_kernel_shared = jax.jit(jax.vmap(_decode_kernel, in_axes=(None, 0)))
 
 
+@jax.jit
+def _decode_recheck_kernel(g_dec, g_enc, shards):
+    """RBC's delivery check in ONE program: interpolate the data
+    shards, re-encode the full shard set, and hash the Merkle forest to
+    its roots (docs/RBC-EN.md:37-39's decode + root recheck).  Fusing
+    the chain keeps the intermediate (B, n, L) shard tensor on device
+    and turns the hub's decode path from 3 dispatches into 1 — dispatch
+    latency, not FLOPs, is the live-protocol cost under a remote TPU
+    attachment (VERDICT round-2 item 2)."""
+    from cleisthenes_tpu.ops.sha256_xla import build_forest
+
+    data = jax.vmap(lambda s: _gf_apply_bits(g_dec, s))(shards)
+    full = jax.vmap(
+        lambda d: jnp.concatenate([d, _gf_apply_bits(g_enc, d)], axis=0)
+    )(data)
+    forest = build_forest(full)  # (B, 2p-1, 32); root is the last node
+    return data, forest[:, -1]
+
+
 class XlaErasureCoder(ErasureCoder):
     def __init__(self, n: int, k: int, mesh=None):
         super().__init__(n, k)
@@ -130,6 +149,34 @@ class XlaErasureCoder(ErasureCoder):
         dev, b, l = self._put_vl(data)
         out = _encode_kernel_batch(self._g_enc, dev)
         return np.asarray(out)[:b, :, :l]
+
+    def decode_recheck_batch(self, indices: np.ndarray, shards: np.ndarray):
+        """Fused decode + re-encode + Merkle roots, or None when the
+        fusion doesn't apply (mesh-sharded runs and mixed erasure
+        patterns use the separate batched kernels instead).
+
+        Returns (data (B, k, L), roots (B, 32)).  The batch axis pads
+        to a power of two (min 8) so each (bucket, k, L) shape compiles
+        once."""
+        if self._mesh is not None or self.n == self.k:
+            return None
+        shards = np.ascontiguousarray(shards, dtype=np.uint8)
+        patterns = [self._normalize_indices(ix) for ix in indices]
+        if len(set(patterns)) != 1:
+            return None
+        g = self._decode_bits(patterns[0])
+        b = shards.shape[0]
+        bucket = 8
+        while bucket < b:
+            bucket <<= 1
+        if bucket != b:
+            shards = np.concatenate(
+                [shards, np.repeat(shards[:1], bucket - b, axis=0)]
+            )
+        data, roots = _decode_recheck_kernel(
+            g, self._g_enc, jnp.asarray(shards)
+        )
+        return np.asarray(data)[:b], np.asarray(roots)[:b]
 
     def decode_batch(
         self, indices: np.ndarray, shards: np.ndarray

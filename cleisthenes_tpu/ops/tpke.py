@@ -36,7 +36,9 @@ import secrets
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from cleisthenes_tpu.ops.modmath import (
+    DEFAULT_GROUP,
     G,
+    GroupParams,
     P,
     Q,
     get_engine,
@@ -53,11 +55,11 @@ def _hash_to_int(*parts: bytes) -> int:
     return int.from_bytes(h.digest(), "big")
 
 
-def _ibytes(x: int) -> bytes:
-    return x.to_bytes(32, "big")
+def _ibytes(x: int, nbytes: int = 32) -> bytes:
+    return x.to_bytes(nbytes, "big")
 
 
-def is_group_element(x: int) -> bool:
+def is_group_element(x: int, group: GroupParams = DEFAULT_GROUP) -> bool:
     """Strict membership test for the prime-order QR subgroup:
     ``1 < x < P`` and ``x^Q == 1 (mod P)``.
 
@@ -69,16 +71,16 @@ def is_group_element(x: int) -> bool:
     share parities via the order-2 component.  One ~256-bit modexp on
     host per check; callers run it once per deserialized ciphertext.
     """
-    return 1 < x < P and host_pow(x, Q) == 1
+    return 1 < x < group.p and host_pow(x, group.q, group) == 1
 
 
-def hash_to_group(data: bytes) -> int:
+def hash_to_group(data: bytes, group: GroupParams = DEFAULT_GROUP) -> int:
     """Map bytes to the QR subgroup with unknown discrete log:
     (H(data) mod p)^2 mod p."""
-    x = _hash_to_int(b"h2g", data) % P
+    x = _hash_to_int(b"h2g", data) % group.p
     if x == 0:
         x = 1
-    return pow(x, 2, P)
+    return pow(x, 2, group.p)
 
 
 # ---------------------------------------------------------------------------
@@ -87,23 +89,24 @@ def hash_to_group(data: bytes) -> int:
 
 
 def _shamir_shares(
-    secret: int, n: int, threshold: int, rng_bytes
+    secret: int, n: int, threshold: int, rng_bytes, q: int = Q
 ) -> List[int]:
     """Evaluate a random degree-(threshold-1) polynomial with
     f(0)=secret at x = 1..n."""
+    nb = max(32, (q.bit_length() + 7) // 8 + 8)  # excess bits: no bias
     coeffs = [secret] + [
-        int.from_bytes(rng_bytes(32), "big") % Q for _ in range(threshold - 1)
+        int.from_bytes(rng_bytes(nb), "big") % q for _ in range(threshold - 1)
     ]
     shares = []
     for x in range(1, n + 1):
         acc = 0
         for c in reversed(coeffs):
-            acc = (acc * x + c) % Q
+            acc = (acc * x + c) % q
         shares.append(acc)
     return shares
 
 
-def lagrange_coeff_at_zero(xs: Sequence[int]) -> List[int]:
+def lagrange_coeff_at_zero(xs: Sequence[int], q: int = Q) -> List[int]:
     """lambda_i = prod_{j!=i} x_j / (x_j - x_i) mod q, for interpolation
     at 0 (Shamir recovery, docs/THRESHOLD_ENCRYPTION-EN.md:36)."""
     out = []
@@ -112,9 +115,9 @@ def lagrange_coeff_at_zero(xs: Sequence[int]) -> List[int]:
         for j, xj in enumerate(xs):
             if i == j:
                 continue
-            num = num * xj % Q
-            den = den * ((xj - xi) % Q) % Q
-        out.append(num * pow(den, -1, Q) % Q)
+            num = num * xj % q
+            den = den * ((xj - xi) % q) % q
+        out.append(num * pow(den, -1, q) % q)
     return out
 
 
@@ -130,6 +133,9 @@ class ThresholdPublicKey:
     threshold: int
     master: int  # h = g^s
     verification_keys: tuple  # h_i = g^{s_i}, 1-indexed by share x = i+1
+    # the group every share op under this key runs in (the modulus
+    # seam: a key set carries its own parameters end to end)
+    group: GroupParams = DEFAULT_GROUP
 
 
 @dataclasses.dataclass(frozen=True)
@@ -150,7 +156,10 @@ class DhShare:
 
 
 def deal(
-    n: int, threshold: int, seed: Optional[int] = None
+    n: int,
+    threshold: int,
+    seed: Optional[int] = None,
+    group: GroupParams = DEFAULT_GROUP,
 ) -> tuple:
     """Trusted-dealer setup (TPKE.SetUp): master pubkey + n secret
     shares.  Deterministic iff ``seed`` given (tests/benchmarks)."""
@@ -158,20 +167,27 @@ def deal(
         ctr = [0]
 
         def rng_bytes(k: int) -> bytes:
-            ctr[0] += 1
-            return hashlib.sha256(
-                b"dealer|%d|%d" % (seed, ctr[0])
-            ).digest()[:k]
+            out = b""
+            while len(out) < k:  # k may exceed one digest (large groups)
+                ctr[0] += 1
+                out += hashlib.sha256(
+                    b"dealer|%d|%d" % (seed, ctr[0])
+                ).digest()
+            return out[:k]
 
     else:
         rng_bytes = secrets.token_bytes
-    s = int.from_bytes(rng_bytes(32), "big") % Q
-    shares = _shamir_shares(s, n, threshold, rng_bytes)
+    # 8 excess bytes: the reduction mod q is statistically unbiased
+    # (bias < 2^-64), matching _shamir_shares' rule
+    s = int.from_bytes(rng_bytes(group.nbytes + 8), "big") % group.q
+    shares = _shamir_shares(s, n, threshold, rng_bytes, group.q)
+    vks = host_pow_batch([group.g] * (n + 1), [s] + shares, group)
     pub = ThresholdPublicKey(
         n=n,
         threshold=threshold,
-        master=pow(G, s, P),
-        verification_keys=tuple(pow(G, si, P) for si in shares),
+        master=vks[0],
+        verification_keys=tuple(vks[1:]),
+        group=group,
     )
     return pub, [
         ThresholdSecretShare(index=i + 1, value=si)
@@ -180,21 +196,33 @@ def deal(
 
 
 def issue_share(
-    share: ThresholdSecretShare, base: int, context: bytes
+    share: ThresholdSecretShare,
+    base: int,
+    context: bytes,
+    group: GroupParams = DEFAULT_GROUP,
 ) -> DhShare:
     """d = base^{s_i} with CP proof bound to ``context``."""
-    w = int.from_bytes(secrets.token_bytes(32), "big") % Q
-    a1, a2, hi, d = host_pow_batch(
-        [G, base, G, base], [w, w, share.value, share.value]
+    # 8 excess bytes -> unbiased nonce: a biased Schnorr/CP nonce
+    # leaks the secret share to a lattice (hidden-number) attack over
+    # many observed shares, since z = w + e*s_i is linear in w
+    w = (
+        int.from_bytes(secrets.token_bytes(group.nbytes + 8), "big")
+        % group.q
     )
+    a1, a2, hi, d = host_pow_batch(
+        [group.g, base, group.g, base],
+        [w, w, share.value, share.value],
+        group,
+    )
+    nb = group.nbytes
     e = (
         _hash_to_int(
-            b"cp", context, _ibytes(base), _ibytes(hi), _ibytes(d),
-            _ibytes(a1), _ibytes(a2),
+            b"cp", context, _ibytes(base, nb), _ibytes(hi, nb),
+            _ibytes(d, nb), _ibytes(a1, nb), _ibytes(a2, nb),
         )
-        % Q
+        % group.q
     )
-    z = (w + e * share.value) % Q
+    z = (w + e * share.value) % group.q
     return DhShare(index=share.index, d=d, e=e, z=z)
 
 
@@ -217,43 +245,57 @@ def verify_share_groups(
     """
     if not groups:
         return []
-    eng = get_engine(backend, mesh)
-    u1, e1, u2, e2 = [], [], [], []
-    for pub, base, shares, _context in groups:
-        for sh in shares:
-            if not (1 <= sh.index <= pub.n):
-                # out-of-roster index: verified vacuously false below by
-                # pinning to vk=1 (never matches an honest transcript)
-                hi = 1
-            else:
+    # one engine (and one batched dispatch) per distinct GroupParams;
+    # in practice a node's TPKE and coin keys share one group, so this
+    # stays a single dispatch
+    by_gp: Dict[GroupParams, List[int]] = {}
+    for gi, (pub, _base, _shares, _context) in enumerate(groups):
+        by_gp.setdefault(pub.group, []).append(gi)
+    results: Dict[int, List[bool]] = {}
+    for gp, idx_list in by_gp.items():
+        eng = get_engine(
+            backend if gp.p.bit_length() <= 256 else "cpu", mesh, gp
+        )
+        u1, e1, u2, e2 = [], [], [], []
+        for gi in idx_list:
+            pub, base, shares, _context = groups[gi]
+            for sh in shares:
+                if not (1 <= sh.index <= pub.n):
+                    # out-of-roster index: verified vacuously false by
+                    # pinning to vk=1 (never matches a real transcript)
+                    hi = 1
+                else:
+                    hi = pub.verification_keys[sh.index - 1]
+                neg_e = (-sh.e) % gp.q
+                # A1 = g^z * hi^{-e}
+                u1.append(gp.g); e1.append(sh.z % gp.q)
+                u2.append(hi); e2.append(neg_e)
+                # A2 = base^z * d^{-e}
+                u1.append(base); e1.append(sh.z % gp.q)
+                u2.append(sh.d % gp.p); e2.append(neg_e)
+        a = eng.dual_pow_batch(u1, e1, u2, e2)
+        off = 0
+        nb = gp.nbytes
+        for gi in idx_list:
+            pub, base, shares, context = groups[gi]
+            res = []
+            for sh in shares:
+                a1, a2 = a[off], a[off + 1]
+                off += 2
+                if not (1 <= sh.index <= pub.n) or not (0 < sh.d < gp.p):
+                    res.append(False)
+                    continue
                 hi = pub.verification_keys[sh.index - 1]
-            neg_e = (-sh.e) % Q
-            # A1 = g^z * hi^{-e}
-            u1.append(G); e1.append(sh.z % Q); u2.append(hi); e2.append(neg_e)
-            # A2 = base^z * d^{-e}
-            u1.append(base); e1.append(sh.z % Q); u2.append(sh.d % P); e2.append(neg_e)
-    a = eng.dual_pow_batch(u1, e1, u2, e2)
-    out: List[List[bool]] = []
-    off = 0
-    for pub, base, shares, context in groups:
-        res = []
-        for sh in shares:
-            a1, a2 = a[off], a[off + 1]
-            off += 2
-            if not (1 <= sh.index <= pub.n) or not (0 < sh.d < P):
-                res.append(False)
-                continue
-            hi = pub.verification_keys[sh.index - 1]
-            e_want = (
-                _hash_to_int(
-                    b"cp", context, _ibytes(base), _ibytes(hi), _ibytes(sh.d),
-                    _ibytes(a1), _ibytes(a2),
+                e_want = (
+                    _hash_to_int(
+                        b"cp", context, _ibytes(base, nb), _ibytes(hi, nb),
+                        _ibytes(sh.d, nb), _ibytes(a1, nb), _ibytes(a2, nb),
+                    )
+                    % gp.q
                 )
-                % Q
-            )
-            res.append(e_want == sh.e % Q)
-        out.append(res)
-    return out
+                res.append(e_want == sh.e % gp.q)
+            results[gi] = res
+    return [results[gi] for gi in range(len(groups))]
 
 
 def verify_shares(
@@ -411,7 +453,9 @@ class SharePool:
 
 
 def combine_shares(
-    shares: Sequence[DhShare], threshold: int
+    shares: Sequence[DhShare],
+    threshold: int,
+    group: GroupParams = DEFAULT_GROUP,
 ) -> int:
     """Lagrange-combine >= threshold verified shares into base^s."""
     if len(shares) < threshold:
@@ -422,10 +466,10 @@ def combine_shares(
     xs = [s.index for s in use]
     if len(set(xs)) != len(xs):
         raise ValueError("duplicate share indices")
-    lams = lagrange_coeff_at_zero(xs)
+    lams = lagrange_coeff_at_zero(xs, group.q)
     acc = 1
-    for term in host_pow_batch([sh.d % P for sh in use], lams):
-        acc = acc * term % P
+    for term in host_pow_batch([sh.d % group.p for sh in use], lams, group):
+        acc = acc * term % group.p
     return acc
 
 
@@ -459,23 +503,37 @@ class Tpke:
         self.pub = pub
         self.backend = backend
         self.mesh = mesh
+        self.group = pub.group  # the key set carries its group
 
     # TPKE.Encrypt (docs/THRESHOLD_ENCRYPTION-EN.md:34)
     def encrypt(self, msg: bytes, rng=secrets) -> Ciphertext:
-        r = int.from_bytes(rng.token_bytes(32), "big") % Q
-        c1, kem = host_pow_batch([G, self.pub.master], [r, r])  # g^r, h^r
-        key = hashlib.sha256(b"kem" + _ibytes(kem)).digest()
+        gp = self.group
+        # 8 excess bytes: unbiased KEM exponent (same rule as
+        # _shamir_shares / issue_share)
+        r = (
+            int.from_bytes(rng.token_bytes(gp.nbytes + 8), "big") % gp.q
+        )
+        c1, kem = host_pow_batch(
+            [gp.g, self.pub.master], [r, r], gp
+        )  # g^r, h^r
+        key = hashlib.sha256(b"kem" + _ibytes(kem, gp.nbytes)).digest()
         c2 = bytes(
             a ^ b for a, b in zip(msg, _keystream(key, len(msg)))
         )
-        tag = hmac.new(key, _ibytes(c1) + c2, hashlib.sha256).digest()
+        tag = hmac.new(
+            key, _ibytes(c1, gp.nbytes) + c2, hashlib.sha256
+        ).digest()
         return Ciphertext(c1=c1, c2=c2, tag=tag)
 
     def context(self, ct: Ciphertext) -> bytes:
         """The CP-proof context binding shares to this ciphertext
         (public: the protocol hub groups cross-instance verifies by
         (pub, base, context))."""
-        return b"tpke|" + _ibytes(ct.c1) + hashlib.sha256(ct.c2).digest()
+        return (
+            b"tpke|"
+            + _ibytes(ct.c1, self.group.nbytes)
+            + hashlib.sha256(ct.c2).digest()
+        )
 
     _context = context  # internal alias
 
@@ -483,7 +541,7 @@ class Tpke:
     def dec_share(
         self, share: ThresholdSecretShare, ct: Ciphertext
     ) -> DhShare:
-        return issue_share(share, ct.c1, self._context(ct))
+        return issue_share(share, ct.c1, self._context(ct), self.group)
 
     def verify_dec_shares(
         self, ct: Ciphertext, shares: Sequence[DhShare]
@@ -503,9 +561,11 @@ class Tpke:
         deterministically for every correct node, since the combined
         KEM value is independent of which valid share subset was used.
         """
-        kem = combine_shares(shares, self.pub.threshold)
-        key = hashlib.sha256(b"kem" + _ibytes(kem)).digest()
-        tag = hmac.new(key, _ibytes(ct.c1) + ct.c2, hashlib.sha256).digest()
+        kem = combine_shares(shares, self.pub.threshold, self.group)
+        key = hashlib.sha256(b"kem" + _ibytes(kem, self.group.nbytes)).digest()
+        tag = hmac.new(
+            key, _ibytes(ct.c1, self.group.nbytes) + ct.c2, hashlib.sha256
+        ).digest()
         if not hmac.compare_digest(tag, ct.tag):
             raise ValueError("TPKE integrity check failed")
         return bytes(

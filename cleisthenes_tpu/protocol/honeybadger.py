@@ -106,20 +106,27 @@ def deserialize_txs(data: bytes) -> List[bytes]:
     return txs
 
 
-def serialize_ciphertext(ct: Ciphertext) -> bytes:
+def serialize_ciphertext(ct: Ciphertext, group=None) -> bytes:
+    """c1 is fixed-width at the roster's group size (a roster-wide
+    constant: every node's NodeKeys carry the same GroupParams, so the
+    wire format is unambiguous — the modulus seam reaches the protocol
+    plane end to end)."""
+    group = group or tpke_mod.DEFAULT_GROUP
     return (
-        ct.c1.to_bytes(32, "big")
+        ct.c1.to_bytes(group.nbytes, "big")
         + struct.pack(">I", len(ct.c2))
         + ct.c2
         + ct.tag
     )
 
 
-def deserialize_ciphertext(data: bytes) -> Ciphertext:
-    if len(data) < 36:
+def deserialize_ciphertext(data: bytes, group=None) -> Ciphertext:
+    group = group or tpke_mod.DEFAULT_GROUP
+    nb = group.nbytes
+    if len(data) < nb + 4:
         raise ValueError("truncated ciphertext")
-    c1 = int.from_bytes(data[:32], "big")
-    if not tpke_mod.is_group_element(c1):
+    c1 = int.from_bytes(data[:nb], "big")
+    if not tpke_mod.is_group_element(c1, group):
         # c1 outside the prime-order subgroup (0, identity, order-2,
         # non-residue) would make every honest node's decryption share
         # fail verification forever — consensus-halting.  Raising here
@@ -127,11 +134,11 @@ def deserialize_ciphertext(data: bytes) -> Ciphertext:
         # path every correct node takes identically (ADVICE.md round-1
         # high finding).
         raise ValueError("ciphertext c1 not in the prime-order subgroup")
-    (ln,) = struct.unpack_from(">I", data, 32)
-    if 36 + ln + 32 != len(data):
+    (ln,) = struct.unpack_from(">I", data, nb)
+    if nb + 4 + ln + 32 != len(data):
         raise ValueError("bad ciphertext framing")
     return Ciphertext(
-        c1=c1, c2=data[36 : 36 + ln], tag=data[36 + ln :]
+        c1=c1, c2=data[nb + 4 : nb + 4 + ln], tag=data[nb + 4 + ln :]
     )
 
 
@@ -155,7 +162,10 @@ class NodeKeys:
 
 
 def setup_keys(
-    config: Config, member_ids: Sequence[str], seed: Optional[int] = None
+    config: Config,
+    member_ids: Sequence[str],
+    seed: Optional[int] = None,
+    group=None,
 ) -> Dict[str, NodeKeys]:
     """TPKE.SetUp + coin setup + MAC master for the whole roster
     (docs/THRESHOLD_ENCRYPTION-EN.md:33; share x-coordinates follow
@@ -169,11 +179,15 @@ def setup_keys(
     members = sorted(member_ids)
     if len(members) != config.n:
         raise ValueError(f"roster size {len(members)} != n={config.n}")
+    group = group or tpke_mod.DEFAULT_GROUP
     tpke_pub, tpke_shares = tpke_mod.deal(
-        config.n, config.decryption_threshold, seed=seed
+        config.n, config.decryption_threshold, seed=seed, group=group
     )
     coin_pub, coin_shares = tpke_mod.deal(
-        config.n, config.f + 1, seed=None if seed is None else seed + 1
+        config.n,
+        config.f + 1,
+        seed=None if seed is None else seed + 1,
+        group=group,
     )
     if seed is None:
         import secrets
@@ -364,7 +378,9 @@ class HoneyBadger:
             self.metrics.epoch_proposed(target)
             es.my_txs = self._create_batch()
             ct = self.tpke.encrypt(serialize_txs(es.my_txs))
-            es.acs.input(serialize_ciphertext(ct))
+            es.acs.input(
+                serialize_ciphertext(ct, self.keys.tpke_pub.group)
+            )
         finally:
             self._exit_turn()
 
@@ -522,7 +538,9 @@ class HoneyBadger:
             self.start_epoch(epoch + 1)
         for proposer, ct_bytes in output.items():
             try:
-                ct = deserialize_ciphertext(ct_bytes)
+                ct = deserialize_ciphertext(
+                    ct_bytes, self.keys.tpke_pub.group
+                )
             except ValueError:
                 # Byzantine proposer RBC'd junk: every correct node
                 # sees the same bytes, so exclusion is deterministic

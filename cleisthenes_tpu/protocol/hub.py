@@ -179,7 +179,9 @@ class CryptoHub:
 
     def _run_decodes(self, items: List[Tuple]) -> None:
         """Interpolate + re-encode + root recheck (docs/RBC-EN.md:37-39)
-        for many instances at once, grouped by shard length."""
+        for many instances at once, grouped by shard length — ONE
+        fused dispatch per group on the 'tpu' backend
+        (BatchCrypto.decode_recheck_batch)."""
         self.decode_items += len(items)
         groups: Dict[Tuple[int, int], List[Tuple]] = {}
         for item in items:
@@ -188,14 +190,14 @@ class CryptoHub:
                 item
             )
         for group in groups.values():
-            self.dispatches += 3  # decode + encode + forest
             idx_arr = np.stack([np.asarray(it[0]) for it in group])
             shard_arr = np.stack([it[1] for it in group])
-            data = self.crypto.erasure.decode_batch(idx_arr, shard_arr)
-            full = self.crypto.erasure.encode_batch(data)
-            trees = self.crypto.merkle.build_batch(full)
-            for it, row, tree in zip(group, data, trees):
-                it[3](row if tree.root == it[2] else None)
+            data, roots, dispatches = self.crypto.decode_recheck_batch(
+                idx_arr, shard_arr
+            )
+            self.dispatches += dispatches
+            for it, row, root in zip(group, data, roots):
+                it[3](row if root.tobytes() == it[2] else None)
 
     def _run_shares(self, items: List[Tuple]) -> None:
         """ALL pooled threshold shares (TPKE decryption + BBA coins,

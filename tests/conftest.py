@@ -34,3 +34,11 @@ def jax_cpu_devices():
     devs = jax.devices()
     assert len(devs) == 8, f"expected 8 virtual CPU devices, got {len(devs)}"
     return devs
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: multi-minute scale tests (full-protocol N>=64 epochs); "
+        "deselect with -m 'not slow'",
+    )

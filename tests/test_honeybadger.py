@@ -320,3 +320,35 @@ class TestEpochPipelining:
             if all(hb.pending_tx_count() == 0 for hb in nodes.values()):
                 break
         assert_identical_batches(nodes)
+
+
+@pytest.mark.slow
+def test_full_epoch_n64_agreement_and_validity():
+    """BASELINE config 3 scale, end to end: N=64, f=21 — north-star
+    quorum math (threshold-22 coin/TPKE, 43-ECHO quorums, depth-6
+    branches) executing as a full protocol epoch, not a crypto unit
+    test (VERDICT round-2 item 4).  CPU backend for CI portability."""
+    n = 64
+    cfg, net, nodes = make_hb_network(
+        n, batch_size=64, auth=True, key_seed=41
+    )
+    cfg_f = (n - 1) // 3
+    assert cfg_f == 21 and cfg.n - 2 * cfg.f == 22
+    txs = push_txs(nodes, 64, prefix=b"n64")
+    for _ in range(4):
+        for hb in nodes.values():
+            hb.start_epoch()
+        net.run()
+        if all(hb.pending_tx_count() == 0 for hb in nodes.values()):
+            break
+    depth = assert_identical_batches(nodes)  # agreement, every node
+    committed = [
+        tx
+        for b in nodes["node0"].committed_batches[:depth]
+        for tx in b.tx_list()
+    ]
+    # validity: everything committed was submitted, nothing duplicated,
+    # and the union of epochs committed every submitted tx
+    assert set(committed) <= set(txs)
+    assert len(committed) == len(set(committed))
+    assert set(committed) == set(txs)
