@@ -192,8 +192,17 @@ class XlaMerkle(MerkleBackend):
     branch proofs scatter across chips with zero collectives.
     """
 
+    # Below this batch size the device round-trip costs more than the
+    # hashes: scalar/small jobs (a VAL's single branch proof, one
+    # proposer's tree) run on host, batch waves run on device.  Under
+    # a remote relay a dispatch is ~30-100 ms; 16 branch hashes are
+    # ~20 us of hashlib.
+    HOST_FLOOR_VERIFY = 16
+    HOST_FLOOR_BUILD = 4
+
     def __init__(self, mesh=None):
         self._mesh = mesh
+        self._host = CpuMerkle()
 
     def _bucket(self, b: int) -> int:
         import math
@@ -231,6 +240,8 @@ class XlaMerkle(MerkleBackend):
         from cleisthenes_tpu.ops.sha256_xla import build_forest
 
         b, n, _ = shards.shape
+        if b * n < self.HOST_FLOOR_BUILD * 8:
+            return self._host.build_batch(shards)
         bucket = self._bucket(b)
         if bucket != b:
             shards = np.concatenate(
@@ -260,6 +271,8 @@ class XlaMerkle(MerkleBackend):
         from cleisthenes_tpu.ops.sha256_xla import verify_branches
 
         b = leaves.shape[0]
+        if b < self.HOST_FLOOR_VERIFY:
+            return self._host.verify_batch(roots, leaves, branches, indices)
         bucket = self._bucket(b)
 
         def pad(a):

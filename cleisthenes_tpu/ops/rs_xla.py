@@ -96,9 +96,19 @@ def _decode_recheck_kernel(g_dec, g_enc, shards):
 
 
 class XlaErasureCoder(ErasureCoder):
+    # A single instance's encode/decode below this byte count runs on
+    # the host numpy path: under a remote TPU attachment one dispatch
+    # round-trip (~30-100 ms) dwarfs a small GF matmul, and the
+    # single-shot ops (one proposer's VAL encode) are exactly the small
+    # case.  Batch waves always go to the device.
+    HOST_FLOOR_BYTES = 1 << 16
+
     def __init__(self, n: int, k: int, mesh=None):
         super().__init__(n, k)
         self.matrix = gf256.systematic_rs_matrix(n, k)
+        from cleisthenes_tpu.ops.rs_cpu import CpuErasureCoder
+
+        self._host = CpuErasureCoder(n, k)
         self._g_enc = jnp.asarray(
             gf256.lift_to_bits(self.matrix[k:]), dtype=jnp.bfloat16
         )
@@ -126,6 +136,8 @@ class XlaErasureCoder(ErasureCoder):
         assert data.ndim == 2 and data.shape[0] == self.k, data.shape
         if self.n == self.k:
             return data.copy()
+        if data.nbytes < self.HOST_FLOOR_BYTES:
+            return self._host.encode(data)
         return np.asarray(_encode_kernel(self._g_enc, jnp.asarray(data)))
 
     def _decode_bits_impl(self, indices: tuple) -> jnp.ndarray:
@@ -133,6 +145,8 @@ class XlaErasureCoder(ErasureCoder):
         return jnp.asarray(gf256.lift_to_bits(inv), dtype=jnp.bfloat16)
 
     def _decode_impl(self, indices: tuple, shards: np.ndarray) -> np.ndarray:
+        if shards.nbytes < self.HOST_FLOOR_BYTES:
+            return self._host._decode_impl(indices, shards)
         return np.asarray(
             _decode_kernel(self._decode_bits(indices), jnp.asarray(shards))
         )
@@ -142,6 +156,8 @@ class XlaErasureCoder(ErasureCoder):
         assert data.ndim == 3 and data.shape[1] == self.k, data.shape
         if self.n == self.k:
             return data.copy()
+        if self._mesh is None and data.nbytes < 4 * self.HOST_FLOOR_BYTES:
+            return self._host.encode_batch(data)
         if self._mesh is None:
             return np.asarray(
                 _encode_kernel_batch(self._g_enc, jnp.asarray(data))
@@ -161,6 +177,8 @@ class XlaErasureCoder(ErasureCoder):
         if self._mesh is not None or self.n == self.k:
             return None
         shards = np.ascontiguousarray(shards, dtype=np.uint8)
+        if shards.nbytes < 4 * self.HOST_FLOOR_BYTES:
+            return None  # tiny job: the host 3-step path wins
         patterns = [self._normalize_indices(ix) for ix in indices]
         if len(set(patterns)) != 1:
             return None
@@ -182,6 +200,8 @@ class XlaErasureCoder(ErasureCoder):
         self, indices: np.ndarray, shards: np.ndarray
     ) -> np.ndarray:
         shards = np.ascontiguousarray(shards, dtype=np.uint8)
+        if self._mesh is None and shards.nbytes < 4 * self.HOST_FLOOR_BYTES:
+            return self._host.decode_batch(indices, shards)
         patterns = [self._normalize_indices(ix) for ix in indices]
         if len(set(patterns)) == 1:
             g = self._decode_bits(patterns[0])

@@ -486,12 +486,25 @@ class Ciphertext:
 
 
 def _keystream(key: bytes, length: int) -> bytes:
-    out = b""
+    out = []
     ctr = 0
-    while len(out) < length:
-        out += hashlib.sha256(key + ctr.to_bytes(4, "big") + b"ks").digest()
+    while 32 * len(out) < length:
+        out.append(
+            hashlib.sha256(key + ctr.to_bytes(4, "big") + b"ks").digest()
+        )
         ctr += 1
-    return out[:length]
+    return b"".join(out)[:length]
+
+
+def _xor_bytes(a: bytes, b: bytes) -> bytes:
+    """a ^ b over equal-length byte strings, vectorized: the stream
+    cipher runs over whole proposed batches (tens of KB per proposer),
+    where a per-byte python loop costs more than the group math."""
+    import numpy as np
+
+    return (
+        np.frombuffer(a, dtype=np.uint8) ^ np.frombuffer(b, dtype=np.uint8)
+    ).tobytes()
 
 
 class Tpke:
@@ -517,9 +530,7 @@ class Tpke:
             [gp.g, self.pub.master], [r, r], gp
         )  # g^r, h^r
         key = hashlib.sha256(b"kem" + _ibytes(kem, gp.nbytes)).digest()
-        c2 = bytes(
-            a ^ b for a, b in zip(msg, _keystream(key, len(msg)))
-        )
+        c2 = _xor_bytes(msg, _keystream(key, len(msg)))
         tag = hmac.new(
             key, _ibytes(c1, gp.nbytes) + c2, hashlib.sha256
         ).digest()
@@ -568,9 +579,7 @@ class Tpke:
         ).digest()
         if not hmac.compare_digest(tag, ct.tag):
             raise ValueError("TPKE integrity check failed")
-        return bytes(
-            a ^ b for a, b in zip(ct.c2, _keystream(key, len(ct.c2)))
-        )
+        return _xor_bytes(ct.c2, _keystream(key, len(ct.c2)))
 
 
 __all__ = [

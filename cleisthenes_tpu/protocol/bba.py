@@ -128,7 +128,7 @@ class BBA:
                 )
             )
         self.hub = hub
-        self.hub.register(epoch, self)
+        self.hub.register((owner, epoch), self)  # see rbc.py note
 
         self.round = 0
         self.est: Optional[bool] = None

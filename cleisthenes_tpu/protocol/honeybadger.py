@@ -282,6 +282,7 @@ class HoneyBadger:
         out,
         auto_propose: bool = True,
         batch_log=None,
+        hub=None,
     ) -> None:
         self.config = config
         self.node_id = node_id
@@ -299,8 +300,16 @@ class HoneyBadger:
         # decryption pools) shares — SURVEY.md §7 hard part 3
         from cleisthenes_tpu.protocol.hub import CryptoHub
 
-        self.hub = CryptoHub(self.crypto)
-        self.hub.register("node", self)  # permanent: dec-share pools
+        # ``hub`` may be SHARED by every in-proc validator of a
+        # simulated cluster: one wave-deferred flush then executes the
+        # WHOLE roster's crypto in single cluster-wide dispatches — the
+        # north star's "vmap across all N validators" framing, and the
+        # only sane shape under a remote TPU attachment where dispatch
+        # round-trips dominate.  Scopes are node-qualified so one
+        # node's epoch GC never drops a peer's clients.  Real
+        # deployments (one validator per host) keep per-node hubs.
+        self.hub = CryptoHub(self.crypto) if hub is None else hub
+        self.hub.register((node_id, "hb"), self)  # permanent: dec-share pools
 
         self.que = TxQueue()
         self.epoch = 0
@@ -455,9 +464,14 @@ class HoneyBadger:
         try:
             payload = msg.payload
             if isinstance(payload, BundlePayload):
-                for item in payload.items:
-                    self._serve_payload(msg.sender_id, item)
+                items = payload.items
+                self.metrics.msgs_in.inc(len(items))  # bulk, not per item
+                serve = self._serve_payload
+                sender = msg.sender_id
+                for item in items:
+                    serve(sender, item)
             else:
+                self.metrics.msgs_in.inc()
                 self._serve_payload(msg.sender_id, payload)
         finally:
             self._exit_turn()
@@ -466,7 +480,6 @@ class HoneyBadger:
         epoch = getattr(payload, "epoch", None)
         if epoch is None:
             return
-        self.metrics.msgs_in.inc()
         # state-sync traffic is deliberately NOT epoch-window gated:
         # it exists exactly for nodes outside the window
         if isinstance(payload, SyncRequestPayload):
@@ -741,7 +754,7 @@ class HoneyBadger:
         if self.batch_log is not None:
             self.batch_log.append(epoch, batch)
         self._epochs.pop(epoch, None)  # any partial local state is moot
-        self.hub.drop_scope(epoch)
+        self.hub.drop_scope((self.node_id, epoch))
         self._sync_responses.clear()
         if self.on_commit is not None:
             self.on_commit(epoch, batch)
@@ -795,7 +808,7 @@ class HoneyBadger:
             e for e in self._epochs if e < self.epoch - KEEP_BEHIND
         ]:
             del self._epochs[stale]
-            self.hub.drop_scope(stale)
+            self.hub.drop_scope((self.node_id, stale))
         # propose into the new epoch if we have work, or if peers
         # already started it (its state exists from buffered traffic)
         if self.auto_propose and (

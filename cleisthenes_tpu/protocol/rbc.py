@@ -86,7 +86,10 @@ class RBC:
 
             hub = CryptoHub(crypto)
         self.hub = hub
-        self.hub.register(epoch, self)
+        # scope is (owner, epoch): a hub may be SHARED by many
+        # in-proc validators (cluster-batched dispatches), and one
+        # node advancing epochs must only drop ITS clients
+        self.hub.register((owner, epoch), self)
 
         # hook set by ACS: fn(proposer_id, value_bytes)
         self.on_deliver: Optional[Callable[[str, bytes], None]] = None

@@ -28,7 +28,7 @@ from __future__ import annotations
 import dataclasses
 import enum
 import struct
-from typing import List, Optional, Tuple, Union
+from typing import List, NamedTuple, Optional, Tuple, Union
 
 _MAGIC = b"CLTP"  # cleisthenes-tpu wire magic
 _VERSION = 1
@@ -60,8 +60,7 @@ class BbaType(enum.IntEnum):
     TERM = 2
 
 
-@dataclasses.dataclass(frozen=True)
-class RbcPayload:
+class RbcPayload(NamedTuple):
     """Reference pb/message.proto:25-35 + rbc/request.go:9-21.
 
     ``proposer``: which RBC instance (one per proposing validator,
@@ -69,6 +68,10 @@ class RbcPayload:
     VAL/ECHO carry (root_hash, branch, shard, shard_index)
     (rbc/request.go:9-17); READY carries root_hash only
     (rbc/request.go:19-21).
+
+    Payloads are NamedTuples, not dataclasses: a wave delivers
+    O(N^2) of them per epoch and tuple construction is ~4x cheaper
+    than a frozen dataclass's object.__setattr__ per field.
     """
 
     type: RbcType
@@ -80,8 +83,7 @@ class RbcPayload:
     shard_index: int = 0
 
 
-@dataclasses.dataclass(frozen=True)
-class BbaPayload:
+class BbaPayload(NamedTuple):
     """Reference pb/message.proto:37-46 + bba/request.go:6-13.
 
     ``proposer``: which BBA instance.  ``round``: the internal BBA
@@ -96,8 +98,7 @@ class BbaPayload:
     value: bool
 
 
-@dataclasses.dataclass(frozen=True)
-class CoinPayload:
+class CoinPayload(NamedTuple):
     """Threshold common-coin share for one (instance, epoch, round)
     (docs/BBA-EN.md:163-181; no reference wire format exists).
 
@@ -114,8 +115,7 @@ class CoinPayload:
     z: int
 
 
-@dataclasses.dataclass(frozen=True)
-class DecSharePayload:
+class DecSharePayload(NamedTuple):
     """TPKE decryption share for one proposer's ciphertext in one epoch
     (docs/THRESHOLD_ENCRYPTION-EN.md:35, docs/HONEYBADGER-EN.md:61-65).
     """
@@ -128,8 +128,7 @@ class DecSharePayload:
     z: int
 
 
-@dataclasses.dataclass(frozen=True)
-class SyncRequestPayload:
+class SyncRequestPayload(NamedTuple):
     """Catch-up request from a lagging/restarted node: "send me the
     committed batch of ``epoch``" (the state-sync step HBBFT itself
     does not define; SURVEY.md §5.3-5.4 recovery story)."""
@@ -137,8 +136,7 @@ class SyncRequestPayload:
     epoch: int
 
 
-@dataclasses.dataclass(frozen=True)
-class SyncResponsePayload:
+class SyncResponsePayload(NamedTuple):
     """One peer's committed batch for ``epoch`` (ledger body bytes).
     A node adopts it only after f+1 distinct senders agree — at least
     one of them is honest, so the batch is the true committed one."""
@@ -147,8 +145,7 @@ class SyncResponsePayload:
     body: bytes
 
 
-@dataclasses.dataclass(frozen=True)
-class BundlePayload:
+class BundlePayload(NamedTuple):
     """Several protocol payloads in ONE authenticated envelope.
 
     HBBFT's per-epoch traffic is O(N^2) broadcast waves where a node
@@ -338,74 +335,157 @@ def _encode_payload(p: Payload) -> Tuple[int, bytes]:
     raise TypeError(f"unknown payload type {type(p)!r}")
 
 
+# Prebound structs: the payload decoder is the receive hot path (a
+# wave delivers O(N^2) items per epoch), so field parsing is inlined
+# offset arithmetic rather than _Reader method calls (~2.5x).
+_U32 = struct.Struct(">I")
+_U64 = struct.Struct(">Q")
+_QQB = struct.Struct(">QQB")
+_QQI = struct.Struct(">QQI")
+_QI = struct.Struct(">QI")
+
+
+def _field(d: bytes, o: int, end: int):
+    """One length-prefixed field within d[..end); returns (bytes, o')."""
+    if o + 4 > end:
+        raise ValueError("truncated frame")
+    (n,) = _U32.unpack_from(d, o)
+    if n > MAX_FIELD_BYTES:
+        raise ValueError(f"field length {n} exceeds cap")
+    o += 4
+    if o + n > end:
+        raise ValueError("truncated frame")
+    return d[o : o + n], o + n
+
+
+def _parse_payload(d: bytes, o: int, end: int, kind: int):
+    """Parse one payload from d[o:end); returns (payload, offset after).
+    The caller checks the offset against ``end`` where canonical
+    (exactly-consumed) bodies are required."""
+    if kind == _KIND_BBA:
+        if o + 1 > end:
+            raise ValueError("truncated frame")
+        t = BbaType(d[o])
+        proposer, o = _field(d, o + 1, end)
+        if o + 17 > end:
+            raise ValueError("truncated frame")
+        epoch, rnd, val = _QQB.unpack_from(d, o)
+        return (
+            BbaPayload(t, proposer.decode("utf-8"), epoch, rnd, bool(val)),
+            o + 17,
+        )
+    if kind == _KIND_COIN:
+        proposer, o = _field(d, o, end)
+        if o + 20 > end:
+            raise ValueError("truncated frame")
+        epoch, rnd, idx = _QQI.unpack_from(d, o)
+        dv, o = _field(d, o + 20, end)
+        ev, o = _field(d, o, end)
+        zv, o = _field(d, o, end)
+        return (
+            CoinPayload(
+                proposer.decode("utf-8"), epoch, rnd, idx,
+                int.from_bytes(dv, "big"), int.from_bytes(ev, "big"),
+                int.from_bytes(zv, "big"),
+            ),
+            o,
+        )
+    if kind == _KIND_DEC:
+        proposer, o = _field(d, o, end)
+        if o + 12 > end:
+            raise ValueError("truncated frame")
+        epoch, idx = _QI.unpack_from(d, o)
+        dv, o = _field(d, o + 12, end)
+        ev, o = _field(d, o, end)
+        zv, o = _field(d, o, end)
+        return (
+            DecSharePayload(
+                proposer.decode("utf-8"), epoch, idx,
+                int.from_bytes(dv, "big"), int.from_bytes(ev, "big"),
+                int.from_bytes(zv, "big"),
+            ),
+            o,
+        )
+    if kind == _KIND_RBC:
+        if o + 1 > end:
+            raise ValueError("truncated frame")
+        t = RbcType(d[o])
+        proposer, o = _field(d, o + 1, end)
+        if o + 8 > end:
+            raise ValueError("truncated frame")
+        (epoch,) = _U64.unpack_from(d, o)
+        root, o = _field(d, o + 8, end)
+        if o + 4 > end:
+            raise ValueError("truncated frame")
+        (nbr,) = _U32.unpack_from(d, o)
+        if nbr > 64:  # Merkle depth cap: 2^64 leaves is beyond any N
+            raise ValueError(f"branch length {nbr} exceeds cap")
+        o += 4
+        branch = []
+        for _ in range(nbr):
+            b, o = _field(d, o, end)
+            branch.append(b)
+        shard, o = _field(d, o, end)
+        if o + 4 > end:
+            raise ValueError("truncated frame")
+        (idx,) = _U32.unpack_from(d, o)
+        return (
+            RbcPayload(
+                t, proposer.decode("utf-8"), epoch, root, tuple(branch),
+                shard, idx,
+            ),
+            o + 4,
+        )
+    if kind == _KIND_SYNC_REQ:
+        if o + 8 > end:
+            raise ValueError("truncated frame")
+        (epoch,) = _U64.unpack_from(d, o)
+        return SyncRequestPayload(epoch), o + 8
+    if kind == _KIND_SYNC_RESP:
+        if o + 8 > end:
+            raise ValueError("truncated frame")
+        (epoch,) = _U64.unpack_from(d, o)
+        body, o = _field(d, o + 8, end)
+        return SyncResponsePayload(epoch, body), o
+    if kind == _KIND_BUNDLE:
+        if o + 4 > end:
+            raise ValueError("truncated frame")
+        (count,) = _U32.unpack_from(d, o)
+        if count > MAX_BUNDLE_ITEMS:
+            raise ValueError(f"bundle count {count} exceeds cap")
+        o += 4
+        items = []
+        append = items.append
+        for _ in range(count):
+            if o + 5 > end:
+                raise ValueError("truncated frame")
+            k = d[o]
+            if k == _KIND_BUNDLE:
+                raise ValueError("nested bundles are not allowed")
+            (ln,) = _U32.unpack_from(d, o + 1)
+            if ln > MAX_FIELD_BYTES:
+                raise ValueError(f"field length {ln} exceeds cap")
+            o += 5
+            item_end = o + ln
+            if item_end > end:
+                raise ValueError("truncated frame")
+            item, consumed = _parse_payload(d, o, item_end, k)
+            if consumed != item_end:
+                # canonical-or-reject: the MAC covers these bytes
+                raise ValueError("trailing bytes in payload body")
+            append(item)
+            o = item_end
+        return BundlePayload(tuple(items)), o
+    raise ValueError(f"unknown payload kind {kind}")
+
+
 def _decode_payload(kind: int, data: bytes) -> Payload:
-    r = _Reader(data)
-    out = _decode_payload_inner(r, kind)
-    if not r.done():
+    out, consumed = _parse_payload(data, 0, len(data), kind)
+    if consumed != len(data):
         # reject non-canonical bodies: the MAC covers the re-encoded
         # canonical form, so trailing junk would make frames malleable
         raise ValueError("trailing bytes in payload body")
     return out
-
-
-def _decode_payload_inner(r: _Reader, kind: int) -> Payload:
-    if kind == _KIND_RBC:
-        t = RbcType(r.u8())
-        proposer = r.str_()
-        epoch = r.u64()
-        root = r.bytes_()
-        nbr = r.u32()
-        if nbr > 64:  # Merkle depth cap: 2^64 leaves is beyond any N
-            raise ValueError(f"branch length {nbr} exceeds cap")
-        branch = tuple(r.bytes_() for _ in range(nbr))
-        shard = r.bytes_()
-        idx = r.u32()
-        return RbcPayload(
-            type=t, proposer=proposer, epoch=epoch, root_hash=root,
-            branch=branch, shard=shard, shard_index=idx,
-        )
-    if kind == _KIND_BBA:
-        t = BbaType(r.u8())
-        proposer = r.str_()
-        epoch = r.u64()
-        rnd = r.u64()
-        val = bool(r.u8())
-        return BbaPayload(
-            type=t, proposer=proposer, epoch=epoch, round=rnd, value=val
-        )
-    if kind == _KIND_COIN:
-        proposer = r.str_()
-        epoch = r.u64()
-        rnd = r.u64()
-        idx = r.u32()
-        return CoinPayload(
-            proposer=proposer, epoch=epoch, round=rnd, index=idx,
-            d=r.int_(), e=r.int_(), z=r.int_(),
-        )
-    if kind == _KIND_DEC:
-        proposer = r.str_()
-        epoch = r.u64()
-        idx = r.u32()
-        return DecSharePayload(
-            proposer=proposer, epoch=epoch, index=idx,
-            d=r.int_(), e=r.int_(), z=r.int_(),
-        )
-    if kind == _KIND_SYNC_REQ:
-        return SyncRequestPayload(epoch=r.u64())
-    if kind == _KIND_SYNC_RESP:
-        return SyncResponsePayload(epoch=r.u64(), body=r.bytes_())
-    if kind == _KIND_BUNDLE:
-        count = r.u32()
-        if count > MAX_BUNDLE_ITEMS:
-            raise ValueError(f"bundle count {count} exceeds cap")
-        items = []
-        for _ in range(count):
-            k = r.u8()
-            if k == _KIND_BUNDLE:
-                raise ValueError("nested bundles are not allowed")
-            items.append(_decode_payload(k, r.bytes_()))
-        return BundlePayload(items=tuple(items))
-    raise ValueError(f"unknown payload kind {kind}")
 
 
 def signing_bytes(msg: Message) -> bytes:

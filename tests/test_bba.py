@@ -150,7 +150,7 @@ def test_bba_garbage_coin_shares_are_rejected():
             return wire
         msg = decode_message(wire)
         if isinstance(msg.payload, CoinPayload):
-            bad = dataclasses.replace(msg.payload, d=12345, z=99999)
+            bad = msg.payload._replace(d=12345, z=99999)
             return encode_message(dataclasses.replace(msg, payload=bad))
         return wire
 
@@ -179,7 +179,7 @@ def test_bba_byzantine_equivocating_bvals_no_split():
         p = msg.payload
         if isinstance(p, BbaPayload) and p.type == BbaType.BVAL:
             flip = receiver in ("node1", "node3")
-            bad = dataclasses.replace(p, value=p.value ^ flip)
+            bad = p._replace(value=p.value ^ flip)
             return encode_message(dataclasses.replace(msg, payload=bad))
         return wire
 

@@ -210,8 +210,8 @@ def test_rbc_corrupt_shard_fails_branch_check():
         if getattr(p, "type", None) == RbcType.ECHO:
             import dataclasses
 
-            bad = dataclasses.replace(
-                p, shard=bytes(len(p.shard))  # zeroed shard, same proof
+            bad = p._replace(
+                shard=bytes(len(p.shard))  # zeroed shard, same proof
             )
             return encode_message(dataclasses.replace(msg, payload=bad))
         return wire
