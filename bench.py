@@ -192,44 +192,16 @@ def modexp_comparator_note() -> str:
 
 
 def build_network(backend: str, n: int = 16, batch: int = 1024):
+    """An in-proc cluster with the shared (cluster-batched) hub — see
+    protocol.cluster.SimulatedCluster; manual epoch stepping."""
     from cleisthenes_tpu.config import Config
-    from cleisthenes_tpu.protocol.honeybadger import HoneyBadger, setup_keys
-    from cleisthenes_tpu.transport.base import HmacAuthenticator
-    from cleisthenes_tpu.transport.broadcast import ChannelBroadcaster
-    from cleisthenes_tpu.transport.channel import ChannelNetwork
+    from cleisthenes_tpu.protocol.cluster import SimulatedCluster
 
-    from cleisthenes_tpu.ops.backend import get_backend
-    from cleisthenes_tpu.protocol.hub import CryptoHub
-
-    cfg = Config(
-        n=n,
-        batch_size=batch,
-        crypto_backend=backend,
-        seed=99,
+    cfg = Config(n=n, batch_size=batch, crypto_backend=backend, seed=99)
+    cluster = SimulatedCluster(
+        config=cfg, key_seed=77, auto_propose=False, shared_hub=True
     )
-    ids = [f"node{i:03d}" for i in range(n)]
-    keys = setup_keys(cfg, ids, seed=77)
-    net = ChannelNetwork()
-    # ONE hub for the whole simulated cluster: a wave flush executes
-    # every validator's pending crypto in cluster-wide batched
-    # dispatches (the north star's "vmaps them across all N
-    # validators' shards at once") — essential under the remote relay,
-    # where per-dispatch round-trips dominate the accelerated path.
-    shared_hub = CryptoHub(get_backend(cfg))
-    nodes = {}
-    for nid in ids:
-        hb = HoneyBadger(
-            config=cfg,
-            node_id=nid,
-            member_ids=ids,
-            keys=keys[nid],
-            out=ChannelBroadcaster(net, nid, ids),
-            auto_propose=False,  # manual epoch stepping for timing
-            hub=shared_hub,
-        )
-        nodes[nid] = hb
-        net.join(nid, hb, HmacAuthenticator(nid, keys[nid].mac_keys))
-    return cfg, net, nodes
+    return cfg, cluster.net, cluster.nodes
 
 
 def measure_protocol(backend: str, n: int, batch: int, epochs: int) -> dict:

@@ -306,3 +306,72 @@ class TestChannelNetwork:
         delivered = net.run()
         assert delivered == 6  # epochs 0..5 ping-pong
         assert r0.seen + r1.seen == 6
+
+
+def test_codec_fuzz_never_crashes():
+    """Decoder robustness: random and mutated frames must decode or
+    raise ValueError — never any other exception (the channel layer
+    catches exactly ValueError; anything else would kill a node on a
+    Byzantine frame)."""
+    import random
+
+    from cleisthenes_tpu.transport.message import (
+        BbaPayload,
+        BbaType,
+        BundlePayload,
+        CoinPayload,
+        DecSharePayload,
+        Message,
+        RbcPayload,
+        RbcType,
+        SyncRequestPayload,
+        SyncResponsePayload,
+        decode_frame,
+        encode_message,
+    )
+
+    rng = random.Random(1234)
+    seeds = [
+        Message(
+            "node-a",
+            1.5,
+            BundlePayload(
+                items=(
+                    RbcPayload(RbcType.ECHO, "p", 1, b"r" * 32,
+                               (b"x" * 32,), b"s" * 8, 1),
+                    BbaPayload(BbaType.BVAL, "p", 1, 0, True),
+                    CoinPayload("p", 1, 0, 1, 7, 8, 9),
+                    DecSharePayload("p", 1, 1, 7, 8, 9),
+                    SyncRequestPayload(1),
+                    SyncResponsePayload(1, b"body"),
+                )
+            ),
+            b"m" * 32,
+        ),
+        Message("node-b", 2.0,
+                RbcPayload(RbcType.READY, "p", 3, b"q" * 32), b"m" * 32),
+    ]
+    wires = [encode_message(m) for m in seeds]
+    for m, w in zip(seeds, wires):
+        assert decode_frame(w)[0] == m  # sanity
+    for _ in range(3000):
+        w = bytearray(rng.choice(wires))
+        for _ in range(rng.randrange(1, 6)):
+            op = rng.randrange(3)
+            if op == 0 and w:  # mutate
+                w[rng.randrange(len(w))] = rng.randrange(256)
+            elif op == 1 and len(w) > 2:  # truncate
+                del w[rng.randrange(1, len(w)) :]
+            else:  # extend
+                w += bytes(rng.randrange(256) for _ in range(rng.randrange(1, 9)))
+        try:
+            decode_frame(bytes(w))
+        except ValueError:
+            pass  # the one allowed failure mode
+    # pure-random frames too
+    for _ in range(2000):
+        blob = bytes(rng.randrange(256) for _ in range(rng.randrange(0, 120)))
+        try:
+            decode_frame(blob)
+        except ValueError:
+            pass
