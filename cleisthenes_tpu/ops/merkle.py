@@ -116,19 +116,18 @@ class MerkleBackend(abc.ABC):
     def verify_branch(
         self, root: bytes, leaf: bytes, branch: Sequence[bytes], index: int
     ) -> bool:
-        if branch:
-            branches = np.stack(
-                [np.frombuffer(s, dtype=np.uint8) for s in branch]
-            )[None]
-        else:  # single-leaf tree: root is the leaf digest
-            branches = np.zeros((1, 0, 32), dtype=np.uint8)
-        ok = self.verify_batch(
-            np.frombuffer(root, dtype=np.uint8)[None],
-            np.frombuffer(leaf, dtype=np.uint8)[None],
-            branches,
-            np.array([index]),
-        )
-        return bool(ok[0])
+        """One proof, pure hashlib: a scalar verify is a handful of
+        SHA-256 calls — array assembly (let alone a device dispatch)
+        costs more than the hashing.  Batch waves use verify_batch."""
+        cur = hashlib.sha256(_LEAF_PREFIX + leaf).digest()
+        idx = index
+        for sib in branch:
+            if idx & 1:
+                cur = hashlib.sha256(_NODE_PREFIX + sib + cur).digest()
+            else:
+                cur = hashlib.sha256(_NODE_PREFIX + cur + sib).digest()
+            idx >>= 1
+        return cur == root
 
     def verify_batch(
         self,

@@ -30,6 +30,7 @@ extension).
 from __future__ import annotations
 
 import dataclasses
+import functools
 import hashlib
 import hmac
 import secrets
@@ -106,9 +107,8 @@ def _shamir_shares(
     return shares
 
 
-def lagrange_coeff_at_zero(xs: Sequence[int], q: int = Q) -> List[int]:
-    """lambda_i = prod_{j!=i} x_j / (x_j - x_i) mod q, for interpolation
-    at 0 (Shamir recovery, docs/THRESHOLD_ENCRYPTION-EN.md:36)."""
+@functools.lru_cache(maxsize=4096)
+def _lagrange_cached(xs: tuple, q: int) -> tuple:
     out = []
     for i, xi in enumerate(xs):
         num, den = 1, 1
@@ -118,7 +118,17 @@ def lagrange_coeff_at_zero(xs: Sequence[int], q: int = Q) -> List[int]:
             num = num * xj % q
             den = den * ((xj - xi) % q) % q
         out.append(num * pow(den, -1, q) % q)
-    return out
+    return tuple(out)
+
+
+def lagrange_coeff_at_zero(xs: Sequence[int], q: int = Q) -> List[int]:
+    """lambda_i = prod_{j!=i} x_j / (x_j - x_i) mod q, for interpolation
+    at 0 (Shamir recovery, docs/THRESHOLD_ENCRYPTION-EN.md:36).
+
+    Cached by index set: an epoch combines N proposals from largely
+    the SAME threshold subset of share indices, and the O(t^2) python
+    coefficient loop was measurable at N=64 (t=22)."""
+    return list(_lagrange_cached(tuple(xs), q))
 
 
 # ---------------------------------------------------------------------------

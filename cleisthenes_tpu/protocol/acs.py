@@ -122,6 +122,33 @@ class ACS:
         elif isinstance(payload, (BbaPayload, CoinPayload)):
             self.bbas[proposer].handle_message(sender, payload)
 
+    # -- columnar wave payloads (transport.message batch kinds) ------------
+
+    def handle_bba_batch(self, sender: str, p) -> None:
+        """One vote fanned across many instances: direct scalar calls,
+        no per-instance payload objects (transport._columnarize)."""
+        bbas = self.bbas
+        t, rnd, value = p.type, p.round, p.value
+        for proposer in p.proposers:
+            bba = bbas.get(proposer)
+            if bba is not None:
+                bba.handle_vote(sender, t, rnd, value)
+
+    def handle_coin_batch(self, sender: str, p) -> None:
+        bbas = self.bbas
+        rnd, index = p.round, p.index
+        for i, proposer in enumerate(p.proposers):
+            bba = bbas.get(proposer)
+            if bba is not None:
+                bba.handle_coin(sender, rnd, index, p.d[i], p.e[i], p.z[i])
+
+    def handle_ready_batch(self, sender: str, p) -> None:
+        rbcs = self.rbcs
+        for i, proposer in enumerate(p.proposers):
+            rbc = rbcs.get(proposer)
+            if rbc is not None:
+                rbc.handle_ready_root(sender, p.roots[i])
+
     # -- composition rules (img/acs.png) -----------------------------------
 
     def _on_rbc_deliver(self, proposer: str, value: bytes) -> None:

@@ -176,6 +176,45 @@ class BBA:
         elif isinstance(payload, CoinPayload):
             self._gated(sender, payload, payload.round)
 
+    # -- scalar entry points (columnar wave payloads) ----------------------
+
+    def handle_vote(self, sender: str, t, rnd: int, value: bool) -> None:
+        """BVAL/AUX/TERM without a payload object: the columnar batch
+        path's per-instance call.  Off-round votes fall back to the
+        parking path (payload built lazily — parking is the rare
+        case)."""
+        if self.halted or sender not in self._member_set:
+            return
+        if t == BbaType.TERM:
+            self._handle_term(sender, value)
+            return
+        if rnd == self.round:
+            if t == BbaType.BVAL:
+                self._handle_bval(sender, value)
+            else:
+                self._handle_aux(sender, value)
+            return
+        self._gated(
+            sender,
+            BbaPayload(t, self.proposer, self.epoch, rnd, value),
+            rnd,
+        )
+
+    def handle_coin(
+        self, sender: str, rnd: int, index: int, d: int, e: int, z: int
+    ) -> None:
+        """Coin share without a payload object (columnar batch path)."""
+        if self.halted or sender not in self._member_set:
+            return
+        if rnd == self.round:
+            self._handle_coin_share_scalar(sender, index, d, e, z)
+            return
+        self._gated(
+            sender,
+            CoinPayload(self.proposer, self.epoch, rnd, index, d, e, z),
+            rnd,
+        )
+
     # -- round gating ------------------------------------------------------
 
     def _gated(self, sender: str, payload, rnd: int) -> None:
@@ -299,12 +338,15 @@ class BBA:
         )
 
     def _handle_coin_share(self, sender: str, p: CoinPayload) -> None:
+        self._handle_coin_share_scalar(sender, p.index, p.d, p.e, p.z)
+
+    def _handle_coin_share_scalar(
+        self, sender: str, index: int, d: int, e: int, z: int
+    ) -> None:
         r = self._cur()
-        if r.coin_value is not None or not (1 <= p.index <= self.n):
+        if r.coin_value is not None or not (1 <= index <= self.n):
             return
-        if r.coin_shares.add(
-            sender, DhShare(index=p.index, d=p.d, e=p.e, z=p.z)
-        ):
+        if r.coin_shares.add(sender, DhShare(index=index, d=d, e=e, z=z)):
             self._maybe_reveal_coin()
 
     def _maybe_reveal_coin(self) -> None:

@@ -54,9 +54,16 @@ class SimulatedCluster:
         group=None,
         member_ids: Optional[Sequence[str]] = None,
     ) -> None:
-        self.config = config or Config(
-            n=n, batch_size=batch_size, crypto_backend=crypto_backend
-        )
+        if config is not None:
+            if n != 4 and n != config.n:  # both given and conflicting
+                raise ValueError(
+                    f"n={n} conflicts with config.n={config.n}; pass one"
+                )
+            self.config = config
+        else:
+            self.config = Config(
+                n=n, batch_size=batch_size, crypto_backend=crypto_backend
+            )
         if member_ids is None:
             member_ids = [f"node{i:03d}" for i in range(self.config.n)]
         self.ids: List[str] = sorted(member_ids)

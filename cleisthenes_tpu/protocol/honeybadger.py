@@ -51,12 +51,16 @@ from cleisthenes_tpu.utils.log import NodeLogger
 from cleisthenes_tpu.utils.metrics import Metrics
 from cleisthenes_tpu.transport.broadcast import CoalescingBroadcaster
 from cleisthenes_tpu.transport.message import (
+    BbaBatchPayload,
     BbaPayload,
     BundlePayload,
+    CoinBatchPayload,
     CoinPayload,
+    DecShareBatchPayload,
     DecSharePayload,
     Message,
     RbcPayload,
+    ReadyBatchPayload,
     SyncRequestPayload,
     SyncResponsePayload,
 )
@@ -219,6 +223,19 @@ def setup_keys(
 # ---------------------------------------------------------------------------
 
 
+def _logical_count(p) -> int:
+    """Logical protocol messages in one payload: a columnar batch
+    carries one vote/share PER INSTANCE, and msgs_in counts logical
+    messages so throughput numbers stay comparable across the
+    scalar->columnar wire change."""
+    proposers = getattr(p, "proposers", None)
+    return len(proposers) if proposers is not None else 1
+
+
+def _logical_count_many(items) -> int:
+    return sum(_logical_count(p) for p in items)
+
+
 class _EpochState:
     __slots__ = (
         "acs",
@@ -287,6 +304,7 @@ class HoneyBadger:
         self.config = config
         self.node_id = node_id
         self.members: List[str] = sorted(member_ids)
+        self._member_set = frozenset(self.members)
         if node_id not in self.members:
             raise ValueError(f"{node_id!r} not in roster")
         self.keys = keys
@@ -465,13 +483,13 @@ class HoneyBadger:
             payload = msg.payload
             if isinstance(payload, BundlePayload):
                 items = payload.items
-                self.metrics.msgs_in.inc(len(items))  # bulk, not per item
+                self.metrics.msgs_in.inc(_logical_count_many(items))
                 serve = self._serve_payload
                 sender = msg.sender_id
                 for item in items:
                     serve(sender, item)
             else:
-                self.metrics.msgs_in.inc()
+                self.metrics.msgs_in.inc(_logical_count(payload))
                 self._serve_payload(msg.sender_id, payload)
         finally:
             self._exit_turn()
@@ -488,15 +506,39 @@ class HoneyBadger:
         if isinstance(payload, SyncResponsePayload):
             self._handle_sync_response(sender_id, payload)
             return
-        es = self._epoch_state(epoch)
+        # fast path: an existing state is by construction inside the
+        # window (stale ones are GC'd), so skip the bounds arithmetic
+        # that _epoch_state re-derives for every one of the O(N^2)
+        # payloads per wave
+        es = self._epochs.get(epoch) or self._epoch_state(epoch)
         if es is None:  # outside the sliding window
             if epoch > self.epoch + EPOCH_HORIZON:
                 # peers are far ahead: we missed epochs, catch up
                 self._request_sync()
             return
         if isinstance(payload, DecSharePayload):
-            self._handle_dec_share(es, sender_id, payload)
-        elif isinstance(payload, (RbcPayload, BbaPayload, CoinPayload)):
+            self._handle_dec_share(
+                epoch, es, sender_id, payload.proposer, payload.index,
+                payload.d, payload.e, payload.z,
+            )
+        elif isinstance(payload, DecShareBatchPayload):
+            idx = payload.index
+            for i, proposer in enumerate(payload.proposers):
+                self._handle_dec_share(
+                    epoch, es, sender_id, proposer, idx,
+                    payload.d[i], payload.e[i], payload.z[i],
+                )
+        elif isinstance(
+            payload,
+            (
+                RbcPayload,
+                BbaPayload,
+                CoinPayload,
+                BbaBatchPayload,
+                CoinBatchPayload,
+                ReadyBatchPayload,
+            ),
+        ):
             # follow the epoch: a peer is running it, so contribute our
             # (possibly empty) proposal too — every correct node must
             # propose or ACS never reaches n-f ones
@@ -506,7 +548,15 @@ class HoneyBadger:
                 and not es.proposed
             ):
                 self.start_epoch()
-            es.acs.handle_message(sender_id, payload)
+            cls = payload.__class__
+            if cls is BbaBatchPayload:
+                es.acs.handle_bba_batch(sender_id, payload)
+            elif cls is CoinBatchPayload:
+                es.acs.handle_coin_batch(sender_id, payload)
+            elif cls is ReadyBatchPayload:
+                es.acs.handle_ready_batch(sender_id, payload)
+            else:
+                es.acs.handle_message(sender_id, payload)
 
     def _epoch_state(self, epoch: int) -> Optional[_EpochState]:
         if not (
@@ -576,21 +626,29 @@ class HoneyBadger:
         self._maybe_commit(epoch, es)
 
     def _handle_dec_share(
-        self, es: _EpochState, sender: str, p: DecSharePayload
+        self,
+        epoch: int,
+        es: _EpochState,
+        sender: str,
+        proposer: str,
+        index: int,
+        d: int,
+        e: int,
+        z: int,
     ) -> None:
         if (
-            sender not in self.members
-            or p.proposer not in self.members  # bounds es.dec_shares
-            or not (1 <= p.index <= self.config.n)
+            sender not in self._member_set
+            or proposer not in self._member_set  # bounds es.dec_shares
+            or not (1 <= index <= self.config.n)
         ):
             return
         pool = es.dec_shares.setdefault(
-            p.proposer, SharePool(self.keys.tpke_pub.threshold)
+            proposer, SharePool(self.keys.tpke_pub.threshold)
         )
-        if not pool.add(sender, DhShare(index=p.index, d=p.d, e=p.e, z=p.z)):
+        if not pool.add(sender, DhShare(index=index, d=d, e=e, z=z)):
             return
-        self._try_decrypt(p.epoch, es, p.proposer)
-        self._maybe_commit(p.epoch, es)
+        self._try_decrypt(epoch, es, proposer)
+        self._maybe_commit(epoch, es)
 
     def _try_decrypt(
         self, epoch: int, es: _EpochState, proposer: str

@@ -267,9 +267,18 @@ class RBC:
             self.hub.request_flush()
         self._maybe_deliver(root)
 
+    def handle_ready_root(self, sender: str, root: bytes) -> None:
+        """READY without a payload object (columnar batch path) —
+        guards mirror handle_message's."""
+        if self.delivered or sender not in self._member_set:
+            return
+        self._handle_ready_root(sender, root)
+
     def _handle_ready(self, sender: str, payload: RbcPayload) -> None:
         """docs/RBC-EN.md:41-42 (reference rbc/rbc.go:64-66)."""
-        root = payload.root_hash
+        self._handle_ready_root(sender, payload.root_hash)
+
+    def _handle_ready_root(self, sender: str, root: bytes) -> None:
         if len(root) != 32:
             return
         if sender in self._ready_voted:  # one READY per sender

@@ -17,7 +17,20 @@ from __future__ import annotations
 import time
 from typing import Dict, List, Protocol, Sequence, runtime_checkable
 
-from cleisthenes_tpu.transport.message import BundlePayload, Message, Payload
+from cleisthenes_tpu.transport.message import (
+    BbaBatchPayload,
+    BbaPayload,
+    BundlePayload,
+    CoinBatchPayload,
+    CoinPayload,
+    DecShareBatchPayload,
+    DecSharePayload,
+    Message,
+    Payload,
+    RbcPayload,
+    RbcType,
+    ReadyBatchPayload,
+)
 
 
 @runtime_checkable
@@ -51,6 +64,86 @@ class ChannelBroadcaster:
 
     def send_to(self, member_id: str, payload: Payload) -> None:
         self._network.post(self._node_id, member_id, self._wrap(payload))
+
+
+def _columnarize(buf: List[Payload]) -> List[Payload]:
+    """Merge a wave buffer's per-instance runs into columnar payloads.
+
+    One wave makes a node emit the same logical message across many
+    concurrent instances — N BVAL(v)s, N coin shares, N dec shares,
+    N READYs differing only in per-instance fields.  Grouping by the
+    shared key (first-occurrence order, so the merge is deterministic)
+    turns O(N) bundle items into one columnar item each: both wire
+    bytes and the receiver's per-item decode/dispatch drop by ~N.
+    Singleton groups stay scalar; VAL/ECHO (bulky per-instance data)
+    and sync payloads pass through unchanged.
+    """
+    groups: dict = {}
+    order: List[tuple] = []
+    for p in buf:
+        cls = p.__class__
+        if cls is BbaPayload:
+            key = ("b", p.type, p.epoch, p.round, p.value)
+        elif cls is CoinPayload:
+            key = ("c", p.epoch, p.round, p.index)
+        elif cls is DecSharePayload:
+            key = ("d", p.epoch, p.index)
+        elif cls is RbcPayload and p.type is RbcType.READY:
+            key = ("r", p.epoch)
+        else:
+            key = ("solo", len(order))  # preserves position, no merge
+        if key in groups:
+            groups[key].append(p)
+        else:
+            groups[key] = [p]
+            order.append(key)
+    out: List[Payload] = []
+    for key in order:
+        run = groups[key]
+        if len(run) == 1:
+            out.append(run[0])
+            continue
+        tag = key[0]
+        if tag == "b":
+            p0 = run[0]
+            out.append(
+                BbaBatchPayload(
+                    p0.type, p0.epoch, p0.round, p0.value,
+                    tuple(p.proposer for p in run),
+                )
+            )
+        elif tag == "c":
+            p0 = run[0]
+            out.append(
+                CoinBatchPayload(
+                    p0.epoch, p0.round, p0.index,
+                    tuple(p.proposer for p in run),
+                    tuple(p.d for p in run),
+                    tuple(p.e for p in run),
+                    tuple(p.z for p in run),
+                )
+            )
+        elif tag == "d":
+            p0 = run[0]
+            out.append(
+                DecShareBatchPayload(
+                    p0.epoch, p0.index,
+                    tuple(p.proposer for p in run),
+                    tuple(p.d for p in run),
+                    tuple(p.e for p in run),
+                    tuple(p.z for p in run),
+                )
+            )
+        else:  # "r"
+            p0 = run[0]
+            out.append(
+                ReadyBatchPayload(
+                    p0.epoch,
+                    tuple(p.proposer for p in run),
+                    tuple(p.root_hash for p in run),
+                )
+            )
+    return out
 
 
 class CoalescingBroadcaster:
@@ -103,7 +196,10 @@ class CoalescingBroadcaster:
 
     @staticmethod
     def _fold(buf: List[Payload]) -> Payload:
-        return buf[0] if len(buf) == 1 else BundlePayload(items=tuple(buf))
+        if len(buf) == 1:
+            return buf[0]
+        items = _columnarize(buf)
+        return items[0] if len(items) == 1 else BundlePayload(tuple(items))
 
     def flush(self) -> None:
         """Ship every buffered payload.  Exception-safe: a transport

@@ -161,6 +161,63 @@ class BundlePayload(NamedTuple):
     items: Tuple["Payload", ...]
 
 
+# -- columnar wave payloads -------------------------------------------------
+#
+# Within one wave a node emits the SAME logical vote across many
+# concurrent instances: N BVALs that differ only in proposer, N coin
+# shares differing in (proposer, d, e, z), N dec shares, N READYs.
+# The coalescer merges such runs into ONE columnar payload per
+# (receiver, key): the shared fields encode once and the per-instance
+# fields are packed columns, so both the wire size and the per-item
+# decode/dispatch cost drop by ~the instance count.  Receivers unpack
+# straight into the instance handlers' scalar entry points.
+
+
+class BbaBatchPayload(NamedTuple):
+    """One BVAL/AUX/TERM vote replicated across many instances:
+    (type, epoch, round, value) shared, proposers columnar."""
+
+    type: BbaType
+    epoch: int
+    round: int
+    value: bool
+    proposers: Tuple[str, ...]
+
+
+class CoinBatchPayload(NamedTuple):
+    """One sender's coin shares for many instances of (epoch, round):
+    share index shared, (proposer, d, e, z) columnar."""
+
+    epoch: int
+    round: int
+    index: int
+    proposers: Tuple[str, ...]
+    d: Tuple[int, ...]
+    e: Tuple[int, ...]
+    z: Tuple[int, ...]
+
+
+class DecShareBatchPayload(NamedTuple):
+    """One sender's TPKE decryption shares for many proposers of one
+    epoch: share index shared, (proposer, d, e, z) columnar."""
+
+    epoch: int
+    index: int
+    proposers: Tuple[str, ...]
+    d: Tuple[int, ...]
+    e: Tuple[int, ...]
+    z: Tuple[int, ...]
+
+
+class ReadyBatchPayload(NamedTuple):
+    """One sender's RBC READYs for many instances of one epoch:
+    (proposer, root) columnar."""
+
+    epoch: int
+    proposers: Tuple[str, ...]
+    roots: Tuple[bytes, ...]
+
+
 Payload = Union[
     RbcPayload,
     BbaPayload,
@@ -169,6 +226,10 @@ Payload = Union[
     SyncRequestPayload,
     SyncResponsePayload,
     BundlePayload,
+    BbaBatchPayload,
+    CoinBatchPayload,
+    DecShareBatchPayload,
+    ReadyBatchPayload,
 ]
 
 # oneof discriminants (reference message.proto:18-22 has rbc=3, bba=4;
@@ -180,6 +241,14 @@ _KIND_DEC = 6
 _KIND_SYNC_REQ = 7
 _KIND_SYNC_RESP = 8
 _KIND_BUNDLE = 9
+_KIND_BBA_BATCH = 10
+_KIND_COIN_BATCH = 11
+_KIND_DEC_BATCH = 12
+_KIND_READY_BATCH = 13
+
+# DoS bound on per-instance columns (a roster is <= 256 under the
+# GF(2^8) shard cap; 4096 leaves margin for multi-round merges)
+MAX_BATCH_ITEMS = 4096
 
 # DoS bound on sub-payloads per bundle (each item is >= 2 bytes on the
 # wire, and the frame itself is capped by MAX_FIELD_BYTES)
@@ -332,7 +401,51 @@ def _encode_payload(p: Payload) -> Tuple[int, bytes]:
             out.append(struct.pack(">B", kind))
             _pack_bytes(out, body)
         return _KIND_BUNDLE, b"".join(out)
+    if isinstance(p, BbaBatchPayload):
+        _check_batch_len(len(p.proposers))
+        out.append(struct.pack(">BQQB", int(p.type), p.epoch, p.round,
+                               int(p.value)))
+        out.append(struct.pack(">I", len(p.proposers)))
+        for s in p.proposers:
+            _pack_str(out, s)
+        return _KIND_BBA_BATCH, b"".join(out)
+    if isinstance(p, CoinBatchPayload):
+        _check_batch_len(len(p.proposers), len(p.d), len(p.e), len(p.z))
+        out.append(struct.pack(">QQI", p.epoch, p.round, p.index))
+        _pack_share_columns(out, p.proposers, p.d, p.e, p.z)
+        return _KIND_COIN_BATCH, b"".join(out)
+    if isinstance(p, DecShareBatchPayload):
+        _check_batch_len(len(p.proposers), len(p.d), len(p.e), len(p.z))
+        out.append(struct.pack(">QI", p.epoch, p.index))
+        _pack_share_columns(out, p.proposers, p.d, p.e, p.z)
+        return _KIND_DEC_BATCH, b"".join(out)
+    if isinstance(p, ReadyBatchPayload):
+        _check_batch_len(len(p.proposers), len(p.roots))
+        out.append(struct.pack(">Q", p.epoch))
+        out.append(struct.pack(">I", len(p.proposers)))
+        for i, s in enumerate(p.proposers):
+            _pack_str(out, s)
+            _pack_bytes(out, p.roots[i])
+        return _KIND_READY_BATCH, b"".join(out)
     raise TypeError(f"unknown payload type {type(p)!r}")
+
+
+def _pack_share_columns(out, proposers, dcol, ecol, zcol) -> None:
+    """(proposer, d, e, z) columns — shared by the coin and dec-share
+    batch payloads so their framings cannot drift apart."""
+    out.append(struct.pack(">I", len(proposers)))
+    for i, s in enumerate(proposers):
+        _pack_str(out, s)
+        _pack_int(out, dcol[i])
+        _pack_int(out, ecol[i])
+        _pack_int(out, zcol[i])
+
+
+def _check_batch_len(*lens: int) -> None:
+    if not lens or min(lens) != max(lens):
+        raise ValueError("columnar payload with ragged columns")
+    if lens[0] == 0 or lens[0] > MAX_BATCH_ITEMS:
+        raise ValueError(f"batch of {lens[0]} items out of range")
 
 
 # Prebound structs: the payload decoder is the receive hot path (a
@@ -343,6 +456,26 @@ _U64 = struct.Struct(">Q")
 _QQB = struct.Struct(">QQB")
 _QQI = struct.Struct(">QQI")
 _QI = struct.Struct(">QI")
+
+
+def _parse_share_columns(d: bytes, o: int, end: int, count: int):
+    """Inverse of _pack_share_columns; returns (proposers, d, e, z, o')."""
+    proposers, dv, ev, zv = [], [], [], []
+    for _ in range(count):
+        s, o = _field(d, o, end)
+        proposers.append(s.decode("utf-8"))
+        x, o = _field(d, o, end)
+        dv.append(int.from_bytes(x, "big"))
+        x, o = _field(d, o, end)
+        ev.append(int.from_bytes(x, "big"))
+        x, o = _field(d, o, end)
+        zv.append(int.from_bytes(x, "big"))
+    return tuple(proposers), tuple(dv), tuple(ev), tuple(zv), o
+
+
+def _check_batch_count(count: int) -> None:
+    if count == 0 or count > MAX_BATCH_ITEMS:
+        raise ValueError(f"batch count {count} out of range")
 
 
 def _field(d: bytes, o: int, end: int):
@@ -435,6 +568,61 @@ def _parse_payload(d: bytes, o: int, end: int, kind: int):
                 shard, idx,
             ),
             o + 4,
+        )
+    if kind == _KIND_BBA_BATCH:
+        if o + 22 > end:
+            raise ValueError("truncated frame")
+        t = BbaType(d[o])
+        epoch, rnd, val = _QQB.unpack_from(d, o + 1)
+        (count,) = _U32.unpack_from(d, o + 18)
+        _check_batch_count(count)
+        o += 22
+        proposers = []
+        for _ in range(count):
+            s, o = _field(d, o, end)
+            proposers.append(s.decode("utf-8"))
+        return (
+            BbaBatchPayload(t, epoch, rnd, bool(val), tuple(proposers)),
+            o,
+        )
+    if kind == _KIND_COIN_BATCH:
+        if o + 24 > end:
+            raise ValueError("truncated frame")
+        epoch, rnd, idx = _QQI.unpack_from(d, o)
+        (count,) = _U32.unpack_from(d, o + 20)
+        _check_batch_count(count)
+        proposers, dv, ev, zv, o = _parse_share_columns(d, o + 24, end, count)
+        return (
+            CoinBatchPayload(epoch, rnd, idx, proposers, dv, ev, zv),
+            o,
+        )
+    if kind == _KIND_DEC_BATCH:
+        if o + 16 > end:
+            raise ValueError("truncated frame")
+        epoch, idx = _QI.unpack_from(d, o)
+        (count,) = _U32.unpack_from(d, o + 12)
+        _check_batch_count(count)
+        proposers, dv, ev, zv, o = _parse_share_columns(d, o + 16, end, count)
+        return (
+            DecShareBatchPayload(epoch, idx, proposers, dv, ev, zv),
+            o,
+        )
+    if kind == _KIND_READY_BATCH:
+        if o + 12 > end:
+            raise ValueError("truncated frame")
+        (epoch,) = _U64.unpack_from(d, o)
+        (count,) = _U32.unpack_from(d, o + 8)
+        _check_batch_count(count)
+        o += 12
+        proposers, roots = [], []
+        for _ in range(count):
+            s, o = _field(d, o, end)
+            proposers.append(s.decode("utf-8"))
+            r, o = _field(d, o, end)
+            roots.append(r)
+        return (
+            ReadyBatchPayload(epoch, tuple(proposers), tuple(roots)),
+            o,
         )
     if kind == _KIND_SYNC_REQ:
         if o + 8 > end:
@@ -557,6 +745,10 @@ __all__ = [
     "SyncRequestPayload",
     "SyncResponsePayload",
     "BundlePayload",
+    "BbaBatchPayload",
+    "CoinBatchPayload",
+    "DecShareBatchPayload",
+    "ReadyBatchPayload",
     "RbcType",
     "BbaType",
     "encode_message",

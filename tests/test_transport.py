@@ -316,14 +316,18 @@ def test_codec_fuzz_never_crashes():
     import random
 
     from cleisthenes_tpu.transport.message import (
+        BbaBatchPayload,
         BbaPayload,
         BbaType,
         BundlePayload,
+        CoinBatchPayload,
         CoinPayload,
+        DecShareBatchPayload,
         DecSharePayload,
         Message,
         RbcPayload,
         RbcType,
+        ReadyBatchPayload,
         SyncRequestPayload,
         SyncResponsePayload,
         decode_frame,
@@ -344,6 +348,12 @@ def test_codec_fuzz_never_crashes():
                     DecSharePayload("p", 1, 1, 7, 8, 9),
                     SyncRequestPayload(1),
                     SyncResponsePayload(1, b"body"),
+                    BbaBatchPayload(BbaType.BVAL, 1, 0, True, ("a", "b")),
+                    CoinBatchPayload(1, 0, 2, ("a", "b"), (1, 2), (3, 4),
+                                     (5, 6)),
+                    DecShareBatchPayload(1, 2, ("a", "b"), (1, 2), (3, 4),
+                                         (5, 6)),
+                    ReadyBatchPayload(1, ("a", "b"), (b"q" * 32, b"w" * 32)),
                 )
             ),
             b"m" * 32,
