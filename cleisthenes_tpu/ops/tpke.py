@@ -462,6 +462,18 @@ class SharePool:
         return self.ready()
 
 
+# The combined value is a pure function of (group, threshold, the
+# chosen subset's (index, d) pairs) — z/e play no part in combining.
+# Every node of a cluster combines the same subset for the same coin
+# or ciphertext, so a bounded memo turns N identical ~threshold-sized
+# exponentiation batches into one (cleared wholesale at the cap; keys
+# carry the share values, so distinct inputs can never collide).
+# Entries hold threshold-many group elements (KBs at large N), so the
+# cap is deliberately small; a working set is ~2N live combines.
+_COMBINE_MEMO: Dict[tuple, int] = {}
+_COMBINE_MEMO_CAP = 1 << 12
+
+
 def combine_shares(
     shares: Sequence[DhShare],
     threshold: int,
@@ -476,10 +488,17 @@ def combine_shares(
     xs = [s.index for s in use]
     if len(set(xs)) != len(xs):
         raise ValueError("duplicate share indices")
+    key = (group, threshold, tuple((s.index, s.d) for s in use))
+    hit = _COMBINE_MEMO.get(key)
+    if hit is not None:
+        return hit
     lams = lagrange_coeff_at_zero(xs, group.q)
     acc = 1
     for term in host_pow_batch([sh.d % group.p for sh in use], lams, group):
         acc = acc * term % group.p
+    if len(_COMBINE_MEMO) >= _COMBINE_MEMO_CAP:
+        _COMBINE_MEMO.clear()
+    _COMBINE_MEMO[key] = acc
     return acc
 
 

@@ -30,6 +30,7 @@ from cleisthenes_tpu.protocol.bba import BBA
 from cleisthenes_tpu.protocol.rbc import RBC
 from cleisthenes_tpu.transport.message import (
     BbaPayload,
+    BbaType,
     CoinPayload,
     RbcPayload,
 )
@@ -65,9 +66,15 @@ class ACS:
 
             hub = CryptoHub(crypto)
         self.hub = hub
+        # one vote bank per epoch: every BBA instance's BVAL/AUX state
+        # as struct-of-arrays, so columnar waves update vectorized
+        # (protocol.votebank)
+        from cleisthenes_tpu.protocol.votebank import VoteBank
+
+        self.bank = VoteBank(self.members, config.f)
         self.rbcs: Dict[str, RBC] = {}
         self.bbas: Dict[str, BBA] = {}
-        for proposer in self.members:
+        for index, proposer in enumerate(self.members):
             rbc = RBC(
                 config=config,
                 crypto=crypto,
@@ -90,6 +97,8 @@ class ACS:
                 coin_secret=coin_secret,
                 out=out,
                 hub=hub,
+                bank=self.bank,
+                index=index,
             )
             bba.on_decide = self._on_bba_decide
             self.bbas[proposer] = bba
@@ -125,14 +134,20 @@ class ACS:
     # -- columnar wave payloads (transport.message batch kinds) ------------
 
     def handle_bba_batch(self, sender: str, p) -> None:
-        """One vote fanned across many instances: direct scalar calls,
-        no per-instance payload objects (transport._columnarize)."""
-        bbas = self.bbas
+        """One vote fanned across many instances: BVAL/AUX go through
+        the vectorized bank; TERM (a handful per instance, ever) stays
+        scalar (transport._columnarize)."""
         t, rnd, value = p.type, p.round, p.value
-        for proposer in p.proposers:
-            bba = bbas.get(proposer)
-            if bba is not None:
-                bba.handle_vote(sender, t, rnd, value)
+        if t == BbaType.TERM:
+            bbas = self.bbas
+            for proposer in p.proposers:
+                bba = bbas.get(proposer)
+                if bba is not None:
+                    bba.handle_vote(sender, t, rnd, value)
+            return
+        self.bank.batch_vote(
+            sender, t == BbaType.BVAL, rnd, value, p.proposers
+        )
 
     def handle_coin_batch(self, sender: str, p) -> None:
         bbas = self.bbas

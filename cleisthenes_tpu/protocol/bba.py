@@ -62,15 +62,15 @@ MAX_ROUNDS = 1000
 
 
 class _Round:
-    """Per-round state (the reference keeps one flat set because it
-    never finished multi-round flow; bba/bba.go:44-51)."""
+    """Per-round SEND + coin state (the reference keeps one flat set
+    because it never finished multi-round flow; bba/bba.go:44-51).
+    BVAL/AUX RECEIPT state lives in the shared VoteBank row — single
+    source of truth for both the columnar and the scalar delivery
+    paths (protocol.votebank)."""
 
     __slots__ = (
         "bval_sent",
-        "bval_recv",
-        "bin_values",
         "aux_sent",
-        "aux_recv",
         "coin_share_sent",
         "coin_shares",
         "coin_value",
@@ -79,10 +79,7 @@ class _Round:
 
     def __init__(self, coin_threshold: int) -> None:
         self.bval_sent: Set[bool] = set()
-        self.bval_recv: Dict[bool, Set[str]] = {True: set(), False: set()}
-        self.bin_values: Set[bool] = set()  # bba/binary_set.go:3-5
         self.aux_sent: Optional[bool] = None
-        self.aux_recv: Dict[str, bool] = {}
         self.coin_share_sent = False
         # sender-keyed with burned-slot tracking: a Byzantine peer can
         # only ever occupy (and burn) its own slot, never censor an
@@ -107,6 +104,8 @@ class BBA:
         coin_secret: ThresholdSecretShare,
         out,
         hub=None,
+        bank=None,
+        index: Optional[int] = None,
     ) -> None:
         self.n = config.n
         self.f = config.f
@@ -115,6 +114,14 @@ class BBA:
         self.owner = owner
         self.members = sorted(member_ids)
         self._member_set = frozenset(self.members)
+        if bank is None:  # standalone use (unit tests): private row
+            from cleisthenes_tpu.protocol.votebank import VoteBank
+
+            bank = VoteBank(self.members, config.f, inst_ids=[proposer])
+            index = 0
+        self.bank = bank
+        self.index = index
+        bank.attach(index, self)
         self.coin = coin
         self.coin_secret = coin_secret
         self.out = out
@@ -194,6 +201,8 @@ class BBA:
             else:
                 self._handle_aux(sender, value)
             return
+        if rnd < self.round:
+            return  # stale: skip even the payload allocation
         self._gated(
             sender,
             BbaPayload(t, self.proposer, self.epoch, rnd, value),
@@ -271,47 +280,64 @@ class BBA:
         )
 
     def _handle_bval(self, sender: str, value: bool) -> None:
-        r = self._cur()
-        recv = r.bval_recv[value]
-        if sender in recv:
+        si = self.bank.sidx.get(sender)
+        if si is None:
             return
-        recv.add(sender)
+        cnt = self.bank.bval_add(self.index, si, value)
+        if cnt is None:  # duplicate
+            return
         # f+1 same bval -> relay once (docs/BBA-EN.md:47-52; the
         # sentBvalSet of bba/bba.go:48)
-        if len(recv) >= self.f + 1:
-            self._broadcast_bval(self.round, value)
+        if cnt >= self.f + 1:
+            self.on_bval_relay(value)
         # 2f+1 -> bin_values union (docs/BBA-EN.md:53-58)
-        if len(recv) >= 2 * self.f + 1 and value not in r.bin_values:
-            r.bin_values.add(value)
-            if r.aux_sent is None:
-                r.aux_sent = value
-                self.out.broadcast(
-                    BbaPayload(
-                        type=BbaType.AUX,
-                        proposer=self.proposer,
-                        epoch=self.epoch,
-                        round=self.round,
-                        value=value,
-                    )
+        if cnt >= 2 * self.f + 1:
+            self.on_bval_bin(value)
+
+    def on_bval_relay(self, value: bool) -> None:
+        """f+1 BVAL crossing (idempotent: bval_sent dedups)."""
+        self._broadcast_bval(self.round, value)
+
+    def on_bval_bin(self, value: bool) -> None:
+        """2f+1 BVAL crossing: bin_values growth (idempotent)."""
+        vi = 1 if value else 0
+        if self.bank.bin_flags[self.index, vi]:
+            return
+        self.bank.set_bin(self.index, value)
+        r = self._cur()
+        if r.aux_sent is None:
+            r.aux_sent = value
+            self.out.broadcast(
+                BbaPayload(
+                    type=BbaType.AUX,
+                    proposer=self.proposer,
+                    epoch=self.epoch,
+                    round=self.round,
+                    value=value,
                 )
-            # bin_values growth can complete the AUX quorum
-            self._maybe_request_coin()
-            self._maybe_advance()
+            )
+        # bin_values growth can complete the AUX quorum
+        self._maybe_request_coin()
+        self._maybe_advance()
 
     def _handle_aux(self, sender: str, value: bool) -> None:
-        r = self._cur()
-        if sender in r.aux_recv:
+        si = self.bank.sidx.get(sender)
+        if si is None:
             return
-        r.aux_recv[sender] = value
+        if not self.bank.aux_add(self.index, si, value):
+            return  # duplicate
+        self._maybe_request_coin()
+        self._maybe_advance()
+
+    def on_aux_quorum(self) -> None:
+        """Columnar-path trigger: the n-f AUX quorum became reachable."""
         self._maybe_request_coin()
         self._maybe_advance()
 
     def _aux_quorum(self) -> bool:
         """n-f AUX messages whose values are in bin_values
         (docs/BBA-EN.md:140-156)."""
-        r = self._cur()
-        good = sum(1 for v in r.aux_recv.values() if v in r.bin_values)
-        return good >= self.n - self.f
+        return self.bank.aux_good(self.index) >= self.n - self.f
 
     # -- common coin (docs/BBA-EN.md:163-181) ------------------------------
 
@@ -413,9 +439,7 @@ class BBA:
         r = self._cur()
         if r.advanced or r.coin_value is None or not self._aux_quorum():
             return
-        vals = {
-            v for v in r.aux_recv.values() if v in r.bin_values
-        }  # docs/BBA-EN.md:140-156
+        vals = self.bank.aux_vals(self.index)  # docs/BBA-EN.md:140-156
         coin = r.coin_value
         r.advanced = True
         if len(vals) == 1:
@@ -432,6 +456,7 @@ class BBA:
         self.round += 1
         self.est = next_est
         self._rounds[self.round] = _Round(self.coin.pub.threshold)
+        self.bank.reset_row(self.index, self.round)
         self._broadcast_bval(self.round, next_est)
         # GC old round, replay parked messages for the new one
         self._rounds.pop(self.round - 1, None)
@@ -480,6 +505,7 @@ class BBA:
             self.halted = True
             self._rounds.clear()
             self._future.clear()
+            self.bank.deactivate(self.index)
 
 
 __all__ = ["BBA", "ROUND_HORIZON", "MAX_ROUNDS"]

@@ -70,7 +70,14 @@ class SimulatedCluster:
         self.keys = setup_keys(self.config, self.ids, seed=key_seed,
                                group=group)
         self.net = ChannelNetwork(seed=seed)
-        hub = CryptoHub(get_backend(self.config)) if shared_hub else None
+        # dedup=True: the shared hub verifies each distinct pure crypto
+        # check ONCE for the whole roster (see CryptoHub docstring) —
+        # the in-proc stand-in for N real hosts verifying in parallel
+        hub = (
+            CryptoHub(get_backend(self.config), dedup=True)
+            if shared_hub
+            else None
+        )
         self.nodes: Dict[str, HoneyBadger] = {}
         for nid in self.ids:
             hb = HoneyBadger(

@@ -56,12 +56,63 @@ from cleisthenes_tpu.ops.tpke import verify_share_groups
 # re-offers work forever.
 MAX_FLUSH_ROUNDS = 64
 
+# Verdict-memo capacities.  Primary eviction is epoch GC (drop_scope
+# clears the memos — every key belongs to some epoch's traffic, and
+# stale entries never pay their rent back); the caps are a second
+# bound for pathological single-epoch volume, sized per entry weight:
+# share keys are a few hundred bytes (big-int triples), branch keys
+# carry a leaf + branch path (~KB), decode keys carry the full shard
+# matrix (~10s of KB).
+SHARE_MEMO_CAP = 1 << 16
+BRANCH_MEMO_CAP = 1 << 15
+DECODE_MEMO_CAP = 1 << 10
+
+
+class _Memo:
+    """Bounded memo of pure-function results (cleared on overflow)."""
+
+    __slots__ = ("map", "cap")
+
+    def __init__(self, cap: int):
+        self.map: Dict = {}
+        self.cap = cap
+
+    def put(self, key, val) -> None:
+        if len(self.map) >= self.cap:
+            self.map.clear()
+        self.map[key] = val
+
 
 class CryptoHub:
-    """Per-node batched-crypto service shared by all protocol instances."""
+    """Per-node batched-crypto service shared by all protocol instances.
 
-    def __init__(self, crypto: BatchCrypto):
+    ``dedup=True`` (the cluster-shared simulation mode) memoizes
+    verification VERDICTS across clients: a coin/TPKE CP check, an
+    ECHO-branch Merkle proof, or an RS decode-recheck is a pure
+    function of its math inputs, and in an N-node in-proc simulation
+    every node receives — and would redundantly re-verify — the same
+    N^2 shares and branches.  The memo executes each distinct check
+    once and fans the verdict out, which is exactly what the N real
+    hosts of a deployed cluster do in parallel wall-clock: per-node
+    work stays honest, only the single-process serialization artifact
+    (N x the same pure computation, run serially) is removed.  Memo
+    keys bind every input the verdict depends on (group, public-key
+    identity, base, context, share values / root, leaf, branch, index),
+    so two different-content messages can never share a verdict.
+    Per-node hubs in a real deployment leave this off: nothing repeats.
+    """
+
+    def __init__(self, crypto: BatchCrypto, dedup: bool = False):
         self.crypto = crypto
+        self.dedup = dedup
+        if dedup:
+            self._share_memo = _Memo(SHARE_MEMO_CAP)
+            self._branch_memo = _Memo(BRANCH_MEMO_CAP)
+            self._decode_memo = _Memo(DECODE_MEMO_CAP)
+            # id(pub) -> (pub, token): small ints stand in for the
+            # (expensive-to-hash) public-key objects in memo keys; the
+            # held reference pins the id against reuse
+            self._pub_tokens: Dict[int, Tuple[object, int]] = {}
         # scope (epoch int, or any hashable) -> clients; scopes drop
         # wholesale when HoneyBadger GCs an epoch
         self._clients: Dict[object, List[object]] = {}
@@ -89,6 +140,13 @@ class CryptoHub:
 
     def drop_scope(self, scope) -> None:
         self._clients.pop(scope, None)
+        if self.dedup:
+            # epoch GC is the natural memo eviction point: all of a
+            # completed epoch's keys are dead, and any live entry a
+            # clear loses costs at most one re-verification
+            self._share_memo.map.clear()
+            self._branch_memo.map.clear()
+            self._decode_memo.map.clear()
 
     # -- flushing ----------------------------------------------------------
 
@@ -146,6 +204,36 @@ class CryptoHub:
         merkle.verify_batch per group (trees of one roster share a
         depth, so this is ~one group per epoch)."""
         self.branch_items += len(items)
+        if self.dedup:
+            memo = self._branch_memo.map
+            local: Dict[Tuple, bool] = {}
+            fresh: List[Tuple] = []
+            for item in items:
+                key = (item[0], item[1], item[2], item[3])
+                if key not in local:
+                    hit = memo.get(key)
+                    if hit is None:
+                        fresh.append(
+                            (item[0], item[1], item[2], item[3], key)
+                        )
+                        local[key] = False  # filled by verify below
+                    else:
+                        local[key] = hit
+            if fresh:
+
+                def fill(it, good, local=local):
+                    local[it[4]] = good
+                    self._branch_memo.put(it[4], good)
+
+                self._verify_branch_groups(fresh, fill)
+            for item in items:
+                item[4](local[(item[0], item[1], item[2], item[3])])
+            return
+        self._verify_branch_groups(items, lambda it, good: it[4](good))
+
+    def _verify_branch_groups(
+        self, items: List[Tuple], deliver: Callable
+    ) -> None:
         groups: Dict[Tuple[int, int], List[Tuple]] = {}
         for item in items:
             _root, leaf, branch, _index, _cb = item
@@ -175,7 +263,7 @@ class CryptoHub:
                 roots, leaves, branches_arr, indices
             )
             for it, good in zip(group, ok):
-                it[4](bool(good))
+                deliver(it, bool(good))
 
     def _run_decodes(self, items: List[Tuple]) -> None:
         """Interpolate + re-encode + root recheck (docs/RBC-EN.md:37-39)
@@ -183,9 +271,42 @@ class CryptoHub:
         fused dispatch per group on the 'tpu' backend
         (BatchCrypto.decode_recheck_batch)."""
         self.decode_items += len(items)
+        if self.dedup:
+            memo = self._decode_memo.map
+            local: Dict[Tuple, object] = {}
+            _miss = object()
+            fresh: List[Tuple] = []
+            keys = []
+            for item in items:
+                key = (item[2], item[0], item[1].tobytes())
+                keys.append(key)
+                if key not in local:
+                    hit = memo.get(key, _miss)
+                    if hit is _miss:
+                        fresh.append((item[0], item[1], item[2], key))
+                        local[key] = None  # filled by decode below
+                    else:
+                        local[key] = hit
+            if fresh:
+
+                def fill(it, row, local=local):
+                    local[it[3]] = row
+                    self._decode_memo.put(it[3], row)
+
+                self._decode_groups(fresh, fill)
+            for item, key in zip(items, keys):
+                row = local[key]
+                # hand each client its own copy: decoded rows feed
+                # straight into batch deserialization and must not
+                # alias across nodes
+                item[3](None if row is None else row.copy())
+            return
+        self._decode_groups(items, lambda it, row: it[3](row))
+
+    def _decode_groups(self, items: List[Tuple], deliver: Callable) -> None:
         groups: Dict[Tuple[int, int], List[Tuple]] = {}
         for item in items:
-            idxs, shards, _root, _cb = item
+            idxs, shards = item[0], item[1]
             groups.setdefault((shards.shape[0], shards.shape[1]), []).append(
                 item
             )
@@ -197,12 +318,15 @@ class CryptoHub:
             )
             self.dispatches += dispatches
             for it, row, root in zip(group, data, roots):
-                it[3](row if root.tobytes() == it[2] else None)
+                deliver(it, row if root.tobytes() == it[2] else None)
 
     def _run_shares(self, items: List[Tuple]) -> None:
         """ALL pooled threshold shares (TPKE decryption + BBA coins,
         every instance) in ONE dual-exponentiation dispatch."""
         self.share_items += sum(len(it[4]) for it in items)
+        if self.dedup:
+            self._run_shares_dedup(items)
+            return
         self.dispatches += 1
         verdicts = verify_share_groups(
             [(pub, base, shs, ctx) for pub, base, ctx, _snd, shs, _cb in items],
@@ -211,6 +335,61 @@ class CryptoHub:
         )
         for item, ok in zip(items, verdicts):
             item[5](item[3], ok)
+
+    def _pub_token(self, pub) -> int:
+        ent = self._pub_tokens.get(id(pub))
+        if ent is None or ent[0] is not pub:
+            ent = (pub, len(self._pub_tokens))
+            self._pub_tokens[id(pub)] = ent
+        return ent[1]
+
+    def _run_shares_dedup(self, items: List[Tuple]) -> None:
+        """Each distinct (pub, base, context, share) CP check verifies
+        once; verdicts fan out to every client that pooled a copy."""
+        memo = self._share_memo.map
+        # local verdict view for THIS call: immune to a memo clear-on-
+        # overflow racing between put and the fan-out read below
+        local: Dict[Tuple, bool] = {}
+        # (token, base, context) -> [(key, share)] of fresh checks
+        fresh: Dict[Tuple, List[Tuple]] = {}
+        fresh_groups: Dict[Tuple, Tuple] = {}
+        item_keys: List[List[Tuple]] = []
+        for pub, base, context, _snd, shares, _cb in items:
+            tok = self._pub_token(pub)
+            gkey = (tok, base, context)
+            keys = []
+            for sh in shares:
+                key = (tok, base, context, sh.index, sh.d, sh.e, sh.z)
+                keys.append(key)
+                if key not in local:
+                    hit = memo.get(key)
+                    if hit is None:
+                        fresh.setdefault(gkey, []).append((key, sh))
+                        fresh_groups[gkey] = (pub, base, context)
+                        local[key] = False  # placeholder, filled below
+                    else:
+                        local[key] = hit
+            item_keys.append(keys)
+        if fresh:
+            self.dispatches += 1
+            groups = []
+            order = []
+            for gkey, pairs in fresh.items():
+                pub, base, context = fresh_groups[gkey]
+                groups.append((pub, base, [sh for _k, sh in pairs], context))
+                order.append(pairs)
+            verdicts = verify_share_groups(
+                groups,
+                backend=self.crypto.engine_backend,
+                mesh=self.crypto.mesh,
+            )
+            put = self._share_memo.put
+            for pairs, oks in zip(order, verdicts):
+                for (key, _sh), good in zip(pairs, oks):
+                    local[key] = good
+                    put(key, good)
+        for (item, keys) in zip(items, item_keys):
+            item[5](item[3], [local[k] for k in keys])
 
     # -- stats -------------------------------------------------------------
 

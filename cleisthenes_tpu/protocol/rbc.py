@@ -346,13 +346,13 @@ class RBC:
     # -- hub client protocol (protocol.hub.CryptoHub) ----------------------
 
     def collect_crypto_work(self, branches, decodes, shares) -> None:
-        if self.delivered:
-            return
-        # pending ECHO proofs -> batched branch verification
-        for root, pool in list(self._pending_echo.items()):
-            if not pool:
-                continue
-            items, self._pending_echo[root] = dict(pool), {}
+        if self.delivered or not (self._pending_echo or self._decode_req):
+            return  # fast path: the hub polls every client per flush
+        # pending ECHO proofs -> batched branch verification (pools
+        # pop wholesale: an emptied root must not linger as an empty
+        # dict and defeat the fast path above)
+        for root in list(self._pending_echo):
+            items = self._pending_echo.pop(root)
             for sender, p in items.items():
                 branches.append(
                     (

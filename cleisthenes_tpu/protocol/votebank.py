@@ -1,0 +1,223 @@
+"""VoteBank: vectorized BVAL/AUX bookkeeping across BBA instances.
+
+An epoch runs N concurrent BBA instances (one per proposer,
+docs/HONEYBADGER-EN.md:85-89), and within one wave a sender emits the
+same logical vote across most of them — the coalescer ships it as ONE
+columnar payload (transport.message BbaBatchPayload).  Per-instance
+scalar processing of such a wave costs O(N) python set/dict operations
+per (sender, receiver) frame, which at N=64 is ~1.8M handler calls per
+epoch — the single largest protocol-plane cost after crypto.
+
+The bank is the TPU-framework answer applied to the host plane: one
+struct-of-arrays per ACS holding every instance's current-round vote
+state, so a columnar wave updates a [n_instances] slice in a handful
+of numpy operations, and only threshold CROSSINGS (f+1 relay, 2f+1
+bin_values growth, n-f AUX quorum — a constant number per instance
+per round) fall back to the per-instance protocol logic in BBA.
+
+Consistency contract: the bank is the SINGLE source of truth for
+BVAL/AUX receipt state of each instance's current round.  BBA's
+scalar path (off-round replays, unit tests, non-columnar transports)
+writes through the same arrays, so columnar and scalar deliveries can
+interleave freely.  When an instance advances a round, its row resets;
+when it halts, its row deactivates and every later delivery for it is
+dropped vectorized, before any python-level dispatch.
+
+Quorum semantics mirrored from BBA (reference docs/BBA-EN.md:39-58,
+134-156): +1 increments make exact-equality crossing detection
+(cnt == f+1, cnt == 2f+1) equivalent to the >=-with-idempotent-guard
+scalar form.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# Byzantine batches can mint unlimited distinct proposer tuples; the
+# index cache clears wholesale at the cap (honest traffic reuses a
+# handful of tuples per wave).
+_PROP_CACHE_CAP = 4096
+
+
+class VoteBank:
+    """Struct-of-arrays vote state for up to ``n_inst`` BBA instances
+    over a fixed roster."""
+
+    def __init__(
+        self,
+        member_ids: Sequence[str],
+        f: int,
+        inst_ids: Optional[Sequence[str]] = None,
+    ) -> None:
+        self.members: List[str] = sorted(member_ids)
+        self.f = f
+        self.sidx: Dict[str, int] = {
+            m: i for i, m in enumerate(self.members)
+        }
+        insts = self.members if inst_ids is None else list(inst_ids)
+        self.iidx: Dict[str, int] = {p: i for i, p in enumerate(insts)}
+        n_inst, ns = len(insts), len(self.members)
+        self.bval_seen = np.zeros((n_inst, ns, 2), dtype=bool)
+        self.bval_cnt = np.zeros((n_inst, 2), dtype=np.int32)
+        self.aux_seen = np.zeros((n_inst, ns), dtype=bool)
+        self.aux_cnt = np.zeros((n_inst, 2), dtype=np.int32)
+        # bin_flags[i, v]: v in instance i's current-round bin_values
+        self.bin_flags = np.zeros((n_inst, 2), dtype=bool)
+        self.row_round = np.zeros(n_inst, dtype=np.int64)
+        self.active = np.ones(n_inst, dtype=bool)
+        self.bbas: List[object] = [None] * n_inst
+        self._prop_cache: Dict[tuple, np.ndarray] = {}
+
+    # -- membership --------------------------------------------------------
+
+    def attach(self, index: int, bba) -> None:
+        self.bbas[index] = bba
+
+    def reset_row(self, index: int, rnd: int) -> None:
+        """New round for one instance: receipt state starts empty."""
+        self.bval_seen[index] = False
+        self.bval_cnt[index] = 0
+        self.aux_seen[index] = False
+        self.aux_cnt[index] = 0
+        self.bin_flags[index] = False
+        self.row_round[index] = rnd
+
+    def deactivate(self, index: int) -> None:
+        """Halted instance: every later delivery drops vectorized."""
+        self.active[index] = False
+
+    # -- scalar write-through (BBA's non-columnar path) --------------------
+
+    def bval_add(self, index: int, sender_idx: int, value: bool):
+        """Record one BVAL; returns the new count, or None if duplicate."""
+        vi = 1 if value else 0
+        if self.bval_seen[index, sender_idx, vi]:
+            return None
+        self.bval_seen[index, sender_idx, vi] = True
+        self.bval_cnt[index, vi] += 1
+        return int(self.bval_cnt[index, vi])
+
+    def aux_add(self, index: int, sender_idx: int, value: bool) -> bool:
+        """Record one AUX; returns False on duplicate sender."""
+        if self.aux_seen[index, sender_idx]:
+            return False
+        self.aux_seen[index, sender_idx] = True
+        self.aux_cnt[index, 1 if value else 0] += 1
+        return True
+
+    def set_bin(self, index: int, value: bool) -> None:
+        self.bin_flags[index, 1 if value else 0] = True
+
+    def aux_good(self, index: int) -> int:
+        """AUX receipts whose value is in bin_values (the n-f quorum
+        basis, docs/BBA-EN.md:140-156) — O(1) from the counters."""
+        g = 0
+        if self.bin_flags[index, 1]:
+            g += int(self.aux_cnt[index, 1])
+        if self.bin_flags[index, 0]:
+            g += int(self.aux_cnt[index, 0])
+        return g
+
+    def aux_vals(self, index: int) -> set:
+        """Distinct received-AUX values that are in bin_values."""
+        vals = set()
+        if self.bin_flags[index, 1] and self.aux_cnt[index, 1] > 0:
+            vals.add(True)
+        if self.bin_flags[index, 0] and self.aux_cnt[index, 0] > 0:
+            vals.add(False)
+        return vals
+
+    # -- columnar delivery (ACS batch path) --------------------------------
+
+    def _indices(self, proposers: tuple) -> np.ndarray:
+        arr = self._prop_cache.get(proposers)
+        if arr is None:
+            iidx = self.iidx
+            arr = np.asarray(
+                [iidx.get(p, -1) for p in proposers], dtype=np.int64
+            )
+            if len(self._prop_cache) >= _PROP_CACHE_CAP:
+                self._prop_cache.clear()
+            self._prop_cache[proposers] = arr
+        return arr
+
+    def batch_vote(
+        self,
+        sender: str,
+        is_bval: bool,
+        rnd: int,
+        value: bool,
+        proposers: tuple,
+    ) -> None:
+        """One sender's vote fanned across ``proposers``: vectorized
+        dedup + counting for in-round instances; off-round instances
+        fall back to BBA's scalar gate (parking / stale-drop)."""
+        si = self.sidx.get(sender)
+        if si is None:
+            return
+        pi = self._indices(proposers)
+        pi = pi[pi >= 0]
+        if pi.size == 0:
+            return
+        live = self.active[pi]
+        pi = pi[live]
+        rounds = self.row_round[pi]
+        on = rounds == rnd
+        # stale (rnd < current round) drops vectorized — same as
+        # _gated's stale return, without N python calls per frame
+        fut = pi[rounds < rnd]
+        # future rounds: scalar fallback (rare — round-horizon
+        # parking; replay order is preserved by BBA._future)
+        if fut.size:
+            from cleisthenes_tpu.transport.message import BbaType
+
+            t = BbaType.BVAL if is_bval else BbaType.AUX
+            for i in fut:
+                bba = self.bbas[i]
+                if bba is not None:
+                    bba.handle_vote(sender, t, rnd, value)
+        sel = pi[on]
+        if sel.size == 0:
+            return
+        sel = np.unique(sel)  # Byzantine batches may repeat instances
+        vi = 1 if value else 0
+        if is_bval:
+            new = sel[~self.bval_seen[sel, si, vi]]
+            if new.size == 0:
+                return
+            self.bval_seen[new, si, vi] = True
+            self.bval_cnt[new, vi] += 1
+            cnts = self.bval_cnt[new, vi]
+            relay = new[cnts == self.f + 1]
+            grow = new[cnts == 2 * self.f + 1]
+            for i in relay:
+                bba = self.bbas[i]
+                if bba is not None and not bba.halted:
+                    bba.on_bval_relay(value)
+            for i in grow:
+                bba = self.bbas[i]
+                if bba is not None and not bba.halted:
+                    bba.on_bval_bin(value)
+        else:
+            new = sel[~self.aux_seen[sel, si]]
+            if new.size == 0:
+                return
+            self.aux_seen[new, si] = True
+            self.aux_cnt[new, vi] += 1
+            # quorum trigger: good >= n-f (>=, not ==: bin_values
+            # growth also moves `good`, so equality could be skipped;
+            # post-quorum extras are cheap idempotent no-ops in BBA)
+            good = self.aux_cnt[new, 1] * self.bin_flags[new, 1] + (
+                self.aux_cnt[new, 0] * self.bin_flags[new, 0]
+            )
+            n = len(self.members)
+            trig = new[good >= n - self.f]
+            for i in trig:
+                bba = self.bbas[i]
+                if bba is not None and not bba.halted:
+                    bba.on_aux_quorum()
+
+
+__all__ = ["VoteBank"]

@@ -126,6 +126,9 @@ class ChannelNetwork:
         self.fault_filter: Optional[FaultFilter] = None
         self.messages_posted = 0
         self.bytes_posted = 0
+        # (kind, body) -> payload: one broadcast's body parses once
+        # for all local receivers (see message.decode_frame)
+        self._payload_memo: dict = {}
 
     # -- topology ----------------------------------------------------------
 
@@ -256,7 +259,9 @@ class ChannelNetwork:
             if ep is None:
                 continue
             try:
-                msg, signing_prefix = decode_frame(wire)
+                msg, signing_prefix = decode_frame(
+                    wire, payload_memo=self._payload_memo
+                )
             except ValueError:
                 ep.rejected += 1
                 continue

@@ -699,14 +699,25 @@ def encode_message(msg: Message) -> bytes:
     return attach_signature(signing_bytes(msg), msg.signature)
 
 
-def decode_frame(data: bytes) -> Tuple[Message, bytes]:
+def decode_frame(
+    data: bytes, payload_memo: Optional[dict] = None
+) -> Tuple[Message, bytes]:
     """Decode a frame into (Message, signing_prefix).
 
     The wire layout is ``signing_bytes || len(sig) || sig``
     (attach_signature), so the exact byte string the MAC covers is a
     PREFIX of the frame — returning it lets authenticators verify
     without re-encoding the payload (at N=64 the re-encode was ~1/5 of
-    the whole epoch's wall clock)."""
+    the whole epoch's wall clock).
+
+    ``payload_memo``: optional (kind, body) -> payload cache for
+    transports that deliver one broadcast's IDENTICAL body bytes to
+    many local receivers (the in-proc ChannelNetwork): the body parses
+    once and the immutable payload object (NamedTuple / frozen
+    dataclass) is shared.  Keyed on the exact bytes, so two distinct
+    frames can never alias; per-receiver envelope fields (sender, ts,
+    signature) are still decoded per frame, and MACs still verify per
+    (sender, receiver) pair."""
     if len(data) < 6 or data[:4] != _MAGIC:
         raise ValueError("bad magic")
     version, kind = data[4], data[5]
@@ -720,15 +731,31 @@ def decode_frame(data: bytes) -> Tuple[Message, bytes]:
     sig = r.bytes_()
     if not r.done():
         raise ValueError("trailing bytes in frame")
+    if payload_memo is None:
+        payload = _decode_payload(kind, body)
+    else:
+        key = (kind, body)
+        payload = payload_memo.get(key)
+        if payload is None:
+            payload = _decode_payload(kind, body)
+            if len(payload_memo) >= _PAYLOAD_MEMO_CAP:
+                payload_memo.clear()
+            payload_memo[key] = payload
     return (
         Message(
             sender_id=sender,
             timestamp=ts,
-            payload=_decode_payload(kind, body),
+            payload=payload,
             signature=sig,
         ),
         signing_prefix,
     )
+
+
+# One wave's broadcast bodies stay hot; the cap bounds memory and a
+# wholesale clear keeps lookups O(1) (bodies recur only within a wave,
+# so eviction costs at most one re-parse per live body).
+_PAYLOAD_MEMO_CAP = 4096
 
 
 def decode_message(data: bytes) -> Message:
