@@ -373,6 +373,7 @@ class BBA:
         if r.coin_value is not None or not (1 <= index <= self.n):
             return
         if r.coin_shares.add(sender, DhShare(index=index, d=d, e=e, z=z)):
+            self.hub.mark_dirty(self)
             self._maybe_reveal_coin()
 
     def _maybe_reveal_coin(self) -> None:

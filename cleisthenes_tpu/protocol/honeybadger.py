@@ -522,12 +522,7 @@ class HoneyBadger:
                 payload.d, payload.e, payload.z,
             )
         elif isinstance(payload, DecShareBatchPayload):
-            idx = payload.index
-            for i, proposer in enumerate(payload.proposers):
-                self._handle_dec_share(
-                    epoch, es, sender_id, proposer, idx,
-                    payload.d[i], payload.e[i], payload.z[i],
-                )
+            self._handle_dec_share_batch(epoch, es, sender_id, payload)
         elif isinstance(
             payload,
             (
@@ -650,6 +645,41 @@ class HoneyBadger:
         self._try_decrypt(epoch, es, proposer)
         self._maybe_commit(epoch, es)
 
+    def _handle_dec_share_batch(
+        self, epoch: int, es: _EpochState, sender: str, payload
+    ) -> None:
+        """One sender's decryption shares across many proposers
+        (DecShareBatchPayload): sender/index validation hoists out of
+        the loop, and the threshold probes (_try_decrypt) plus the
+        commit check run once per TOUCHED proposer / once per frame
+        instead of once per share — identical outcomes, since neither
+        has observable effects below its threshold."""
+        index = payload.index
+        if sender not in self._member_set or not (
+            1 <= index <= self.config.n
+        ):
+            return
+        member = self._member_set
+        pools = es.dec_shares
+        threshold = self.keys.tpke_pub.threshold
+        dcol, ecol, zcol = payload.d, payload.e, payload.z
+        touched = []
+        for i, proposer in enumerate(payload.proposers):
+            if proposer not in member:
+                continue
+            pool = pools.get(proposer)
+            if pool is None:
+                pool = pools.setdefault(proposer, SharePool(threshold))
+            if pool.add(
+                sender, DhShare(index=index, d=dcol[i], e=ecol[i], z=zcol[i])
+            ):
+                touched.append(proposer)
+        if not touched:
+            return
+        for proposer in touched:
+            self._try_decrypt(epoch, es, proposer)
+        self._maybe_commit(epoch, es)
+
     def _try_decrypt(
         self, epoch: int, es: _EpochState, proposer: str
     ) -> None:
@@ -675,6 +705,7 @@ class HoneyBadger:
                 plain = self.tpke.combine(ct, subset)
             except ValueError:  # bad tag: an invalid share slipped in
                 es.opt_failed.add(proposer)
+                self.hub.mark_dirty(self)
                 self.hub.request_flush()
                 return
             try:
@@ -684,6 +715,8 @@ class HoneyBadger:
                 # proposer's own doing, identical at every node
                 es.decrypted[proposer] = None
             return
+        # flagged proposer: freshly pooled shares need CP verification
+        self.hub.mark_dirty(self)
         self.hub.request_flush()
 
     # -- hub client protocol (protocol.hub.CryptoHub) ----------------------

@@ -22,6 +22,10 @@ dual-exponentiation dispatch via tpke.verify_share_groups.
 
 Client protocol (duck-typed; see RBC/BBA/HoneyBadger):
 
+  hub.mark_dirty(client)
+      REQUIRED whenever pending crypto work appears or becomes
+      unblocked (parked branch, staged decode, pooled share); a flush
+      round polls only dirty clients
   collect_crypto_work(branches, decodes, shares) -> None
       append pending work items; pending state moves to in-flight
   after_crypto_flush() -> None
@@ -116,6 +120,14 @@ class CryptoHub:
         # scope (epoch int, or any hashable) -> clients; scopes drop
         # wholesale when HoneyBadger GCs an epoch
         self._clients: Dict[object, List[object]] = {}
+        # Clients with (possibly) pending work: every state change
+        # that creates or unblocks crypto work calls mark_dirty, and a
+        # flush round polls ONLY drained-dirty clients — at N
+        # validators x N instances, polling every registered client
+        # every round was a top-5 epoch cost.  A client that stages
+        # work without marking itself dirty will stall: marking is
+        # part of the client protocol (see class docstring).
+        self._dirty: set = set()
         self._flushing = False
         # Deferred mode (HoneyBadger.transport_manages_idle sets
         # ``hub.defer = True`` when its transport promises an idle
@@ -138,8 +150,16 @@ class CryptoHub:
     def register(self, scope, client) -> None:
         self._clients.setdefault(scope, []).append(client)
 
+    def mark_dirty(self, client) -> None:
+        """Client protocol: call whenever pending crypto work appears
+        or becomes unblocked (a parked branch, a staged decode, a
+        pooled share).  Idempotent and O(1)."""
+        self._dirty.add(client)
+
     def drop_scope(self, scope) -> None:
-        self._clients.pop(scope, None)
+        dropped = self._clients.pop(scope, None)
+        if dropped:
+            self._dirty.difference_update(dropped)
         if self.dedup:
             # epoch GC is the natural memo eviction point: all of a
             # completed epoch's keys are dead, and any live entry a
@@ -176,12 +196,13 @@ class CryptoHub:
         self.flushes += 1
         try:
             for _ in range(MAX_FLUSH_ROUNDS):
+                if not self._dirty:
+                    break
+                clients = list(self._dirty)
+                self._dirty.clear()
                 branches: List[Tuple] = []
                 decodes: List[Tuple] = []
                 shares: List[Tuple] = []
-                clients = [
-                    c for cs in self._clients.values() for c in cs
-                ]
                 for c in clients:
                     c.collect_crypto_work(branches, decodes, shares)
                 if not (branches or decodes or shares):
@@ -192,6 +213,9 @@ class CryptoHub:
                     self._run_decodes(decodes)
                 if shares:
                     self._run_shares(shares)
+                # executor callbacks may re-mark clients (e.g. a
+                # verified ECHO shard completes a staged decode); the
+                # next loop round drains them
                 for c in clients:
                     c.after_crypto_flush()
         finally:

@@ -259,6 +259,7 @@ class RBC:
             return
         self._echo_voted.add(sender)  # slot claimed; burns if invalid
         self._pending_echo.setdefault(root, {})[sender] = payload
+        self.hub.mark_dirty(self)
         if (
             self._echo_potential(root) >= self.n - self.f
             and self._ready_root is None
@@ -314,6 +315,7 @@ class RBC:
         ):
             return
         self._decode_req.add(root)
+        self.hub.mark_dirty(self)
 
     def _maybe_deliver(self, root: bytes) -> None:
         """2f+1 READY(h) + N-2f verified shards -> deliver
@@ -391,6 +393,11 @@ class RBC:
                 return
             self._echo_senders.setdefault(root, set()).add(sender)
             self._shards.setdefault(root, {})[p.shard_index] = p.shard
+            # a staged decode may just have reached k shards — stay on
+            # the hub's dirty list for its next round (no decode
+            # staged -> nothing new to collect, skip the re-mark)
+            if self._decode_req:
+                self.hub.mark_dirty(self)
 
         return cb
 
