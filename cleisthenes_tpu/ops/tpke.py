@@ -236,6 +236,121 @@ def issue_share(
     return DhShare(index=share.index, d=d, e=e, z=z)
 
 
+def issue_shares_batch(
+    items: Sequence[tuple],
+    group: GroupParams = DEFAULT_GROUP,
+    backend: str = "cpu",
+    mesh=None,
+) -> List[DhShare]:
+    """Issue MANY shares in one batched exponentiation dispatch.
+
+    ``items``: sequence of ``(share, base, context, vk)`` — ``vk`` is
+    the issuer's public verification key g^{s_i} (``None`` recomputes
+    it, costing one extra exponentiation per item).  Semantics match
+    ``issue_share`` exactly; this is the lockstep executor's path,
+    where a synchronous wave issues N^2 coin/decryption shares at once
+    (protocol.spmd) instead of one 4-exponentiation batch per share.
+    """
+    if not items:
+        return []
+    eng = get_engine(
+        backend if group.p.bit_length() <= 256 else "cpu", mesh, group
+    )
+    q, g = group.q, group.g
+    nbytes = group.nbytes
+    ws = []
+    bases_flat: List[int] = []
+    exps_flat: List[int] = []
+    for share, base, _context, vk in items:
+        w = (
+            int.from_bytes(secrets.token_bytes(nbytes + 8), "big") % q
+        )  # unbiased nonce: same rule (and reason) as issue_share
+        ws.append(w)
+        bases_flat.append(g)
+        exps_flat.append(w)  # a1 = g^w
+        bases_flat.append(base)
+        exps_flat.append(w)  # a2 = base^w
+        bases_flat.append(base)
+        exps_flat.append(share.value)  # d = base^{s_i}
+        if vk is None:
+            bases_flat.append(g)
+            exps_flat.append(share.value)  # h_i = g^{s_i}
+    pows = eng.pow_batch(bases_flat, exps_flat)
+    out: List[DhShare] = []
+    off = 0
+    for (share, base, context, vk), w in zip(items, ws):
+        a1, a2, d = pows[off], pows[off + 1], pows[off + 2]
+        off += 3
+        if vk is None:
+            hi = pows[off]
+            off += 1
+        else:
+            hi = vk
+        e = (
+            _hash_to_int(
+                b"cp", context, _ibytes(base, nbytes), _ibytes(hi, nbytes),
+                _ibytes(d, nbytes), _ibytes(a1, nbytes), _ibytes(a2, nbytes),
+            )
+            % q
+        )
+        z = (w + e * share.value) % q
+        out.append(DhShare(index=share.index, d=d, e=e, z=z))
+    return out
+
+
+def combine_shares_batch(
+    share_sets: Sequence[Sequence[DhShare]],
+    threshold: int,
+    group: GroupParams = DEFAULT_GROUP,
+    backend: str = "cpu",
+    mesh=None,
+) -> List[int]:
+    """Lagrange-combine many independent share sets in ONE
+    exponentiation dispatch (each set >= threshold verified shares;
+    result order matches input order).  Equivalent to mapping
+    ``combine_shares``, and shares its memo."""
+    if not share_sets:
+        return []
+    eng = get_engine(
+        backend if group.p.bit_length() <= 256 else "cpu", mesh, group
+    )
+    results: List[Optional[int]] = [None] * len(share_sets)
+    bases_flat: List[int] = []
+    exps_flat: List[int] = []
+    spans: List[tuple] = []  # (set_idx, memo_key, n_terms)
+    for si, shares in enumerate(share_sets):
+        if len(shares) < threshold:
+            raise ValueError(
+                f"need >= {threshold} shares to combine, got {len(shares)}"
+            )
+        use = sorted(shares, key=lambda s: s.index)[:threshold]
+        xs = [s.index for s in use]
+        if len(set(xs)) != len(xs):
+            raise ValueError("duplicate share indices")
+        key = (group, threshold, tuple((s.index, s.d) for s in use))
+        hit = _COMBINE_MEMO.get(key)
+        if hit is not None:
+            results[si] = hit
+            continue
+        lams = lagrange_coeff_at_zero(xs, group.q)
+        bases_flat.extend(sh.d % group.p for sh in use)
+        exps_flat.extend(lams)
+        spans.append((si, key, threshold))
+    if bases_flat:
+        pows = eng.pow_batch(bases_flat, exps_flat)
+        off = 0
+        for si, key, n_terms in spans:
+            acc = 1
+            for term in pows[off : off + n_terms]:
+                acc = acc * term % group.p
+            off += n_terms
+            if len(_COMBINE_MEMO) >= _COMBINE_MEMO_CAP:
+                _COMBINE_MEMO.clear()
+            _COMBINE_MEMO[key] = acc
+            results[si] = acc
+    return results  # type: ignore[return-value]
+
+
 def verify_share_groups(
     groups: Sequence[tuple],
     backend: str = "cpu",
@@ -620,9 +735,11 @@ __all__ = [
     "Ciphertext",
     "deal",
     "issue_share",
+    "issue_shares_batch",
     "verify_shares",
     "verify_share_groups",
     "combine_shares",
+    "combine_shares_batch",
     "lagrange_coeff_at_zero",
     "hash_to_group",
     "Tpke",

@@ -1,0 +1,356 @@
+"""LockstepCluster: one HBBFT epoch for ALL N validators as batched
+array programs — the SPMD answer to BASELINE configs 4 and 5.
+
+The message-passing path (protocol.cluster.SimulatedCluster) executes
+the protocol one delivered frame at a time; faithful, asynchronous,
+Byzantine-capable — and at N=128 the per-message host work dominates
+any accelerator.  This module is the other end of the framework's
+design space: under a BENIGN schedule (no crashes, no equivocation,
+reliable in-order delivery — the schedule every benchmark of the
+reference's lineage measures, docs/HONEYBADGER-EN.md:110-113) the
+protocol's data flow is a fixed sequence of synchronous waves, and
+each wave is a single batched crypto call over every (node, instance)
+pair at once:
+
+  propose   N TPKE encryptions
+  RBC       1 batched RS encode (N proposals) + 1 Merkle forest build
+            + 1 batched verify of the N^2 distinct (proposer, shard)
+            ECHO branches + 1 fused decode/re-encode/root-recheck over
+            N proposals
+  BBA       per round: N^2 coin-share issues (one batched
+            exponentiation dispatch), (f+1) x N CP verifications (one
+            dispatch), N Lagrange combines (one dispatch)
+  decrypt   N^2 decryption-share issues (one dispatch) + N optimistic
+            combines (one dispatch) with ciphertext-tag checks
+  commit    the reference dedup/commit rule, one Batch per epoch
+
+Work accounting is the DEDUPLICATED cluster total — each distinct
+pure computation once, exactly like the shared-hub CryptoHub memo
+(protocol.hub): per-node honest work is preserved, only the
+single-process artifact of re-running identical math N times is gone.
+Share ISSUANCE is not deduplicable (each node's secret differs) and
+runs at full N^2 volume.
+
+Every cryptographic operation is the real one, from the same ops/
+kernels the live protocol uses; the commit rule is HoneyBadger's own
+(protocol.honeybadger._maybe_commit).  What the lockstep path does NOT
+exercise: the wire codec, MAC authentication, asynchronous scheduling,
+and fault handling — tests/test_spmd.py cross-validates its committed
+output against the full message-passing cluster instead.
+
+The coin is the real threshold VUF: per (instance, round) all N
+shares are issued with CP proofs, f+1 verify, and the combined value
+decides the round exactly as protocol.bba does — so round counts are
+the true geometric distribution, not a stub.
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from cleisthenes_tpu.config import Config
+from cleisthenes_tpu.core.batch import Batch
+from cleisthenes_tpu.ops.backend import get_backend
+from cleisthenes_tpu.ops.payload import join_payload, split_payload
+from cleisthenes_tpu.ops.tpke import (
+    combine_shares_batch,
+    issue_shares_batch,
+    verify_share_groups,
+)
+from cleisthenes_tpu.protocol.honeybadger import (
+    deserialize_ciphertext,
+    deserialize_txs,
+    serialize_ciphertext,
+    serialize_txs,
+    setup_keys,
+)
+
+# A round decides with probability 1/2 per instance; 64 rounds is
+# P ~ 2^-64 per instance — the same class of bound as bba.MAX_ROUNDS.
+MAX_COIN_ROUNDS = 64
+
+
+class LockstepCluster:
+    """N validators, synchronous benign schedule, batched waves."""
+
+    def __init__(
+        self,
+        n: int = 4,
+        *,
+        config: Optional[Config] = None,
+        batch_size: int = 256,
+        crypto_backend: str = "cpu",
+        key_seed: int = 1,
+        member_ids: Optional[Sequence[str]] = None,
+        group=None,
+    ) -> None:
+        if config is not None:
+            if n != 4 and n != config.n:
+                raise ValueError(
+                    f"n={n} conflicts with config.n={config.n}; pass one"
+                )
+            self.config = config
+        else:
+            self.config = Config(
+                n=n, batch_size=batch_size, crypto_backend=crypto_backend
+            )
+        cfg = self.config
+        if member_ids is None:
+            member_ids = [f"node{i:03d}" for i in range(cfg.n)]
+        self.ids: List[str] = sorted(member_ids)
+        self.keys = setup_keys(cfg, self.ids, seed=key_seed, group=group)
+        self.crypto = get_backend(cfg)
+        k0 = self.keys[self.ids[0]]
+        self.tpke = self.crypto.tpke(k0.tpke_pub)
+        self.coin = self.crypto.coin(k0.coin_pub)
+        self.queues: Dict[str, collections.deque] = {
+            nid: collections.deque() for nid in self.ids
+        }
+        self.committed_batches: List[Batch] = []
+        self.epoch = 0
+        self._rr = 0
+        # b = max(B, n): the reference's batch floor
+        # (honeybadger.go:62-104 via protocol.honeybadger)
+        self.b = max(cfg.batch_size, cfg.n)
+        self.last_stats: Dict[str, float] = {}
+
+    # -- application surface ----------------------------------------------
+
+    def submit(self, tx: bytes, node_id: Optional[str] = None) -> None:
+        if node_id is None:
+            node_id = self.ids[self._rr % len(self.ids)]
+            self._rr += 1
+        self.queues[node_id].append(tx)
+
+    def pending_tx_count(self) -> int:
+        return sum(len(q) for q in self.queues.values())
+
+    def committed(self, node_id: Optional[str] = None) -> List[Batch]:
+        """Per the agreement property every node's history is the
+        same list; ``node_id`` is accepted for SimulatedCluster API
+        compatibility."""
+        return list(self.committed_batches)
+
+    # -- one epoch ---------------------------------------------------------
+
+    def run_epoch(self) -> Dict[str, float]:
+        cfg = self.config
+        n, f, k = cfg.n, cfg.f, cfg.data_shards
+        ids = self.ids
+        group = self.tpke.group
+        backend = self.crypto.engine_backend
+        mesh = self.crypto.mesh
+        stats: Dict[str, float] = {}
+        t_all = time.perf_counter()
+
+        # ---- propose: batch select + TPKE encrypt (N ciphertexts) ----
+        t0 = time.perf_counter()
+        per_node = self.b // n
+        my_txs: Dict[str, List[bytes]] = {}
+        values: List[bytes] = []
+        for nid in ids:
+            q = self.queues[nid]
+            txs = [q.popleft() for _ in range(min(per_node, len(q)))]
+            my_txs[nid] = txs
+            ct = self.tpke.encrypt(serialize_txs(txs))
+            values.append(serialize_ciphertext(ct, group))
+        stats["propose_s"] = time.perf_counter() - t0
+
+        # ---- RBC: encode + forest + N^2 branch verify + decode ----
+        t0 = time.perf_counter()
+        mats = [split_payload(v, k) for v in values]
+        L = max(m.shape[1] for m in mats)
+        data = np.zeros((n, k, L), dtype=np.uint8)
+        for i, m in enumerate(mats):
+            data[i, :, : m.shape[1]] = m
+        full = self.crypto.erasure.encode_batch(data)  # (n, n, L)
+        trees = self.crypto.merkle.build_batch(full)
+        roots = [t.root for t in trees]
+        stats["rbc_encode_s"] = time.perf_counter() - t0
+
+        # the N^2 distinct ECHO-phase proofs (docs/HONEYBADGER-EN.md:96),
+        # one batched verify — the deduplicated receiver-side work
+        t0 = time.perf_counter()
+        root_arr = np.repeat(
+            np.frombuffer(b"".join(roots), dtype=np.uint8).reshape(n, 32),
+            n,
+            axis=0,
+        )
+        leaves = np.ascontiguousarray(full.reshape(n * n, L))
+        depth = trees[0].depth
+        branches = np.zeros((n * n, depth, 32), dtype=np.uint8)
+        for i, tree in enumerate(trees):
+            for j in range(n):
+                br = tree.branch(j)
+                for d_, sib in enumerate(br):
+                    branches[i * n + j, d_] = np.frombuffer(
+                        sib, dtype=np.uint8
+                    )
+        indices = np.tile(np.arange(n), n)
+        ok = self.crypto.merkle.verify_batch(
+            root_arr, leaves, branches, indices
+        )
+        if not bool(np.all(ok)):
+            raise AssertionError("honest branch failed verification")
+        stats["rbc_verify_s"] = time.perf_counter() - t0
+
+        # delivery: fused decode + re-encode + root recheck over all N
+        t0 = time.perf_counter()
+        idx_arr = np.tile(np.arange(k), (n, 1))
+        shard_arr = np.ascontiguousarray(full[:, :k, :])
+        dec_data, dec_roots, _disp = self.crypto.decode_recheck_batch(
+            idx_arr, shard_arr
+        )
+        delivered: List[bytes] = []
+        for i in range(n):
+            if dec_roots[i].tobytes() != roots[i]:
+                raise AssertionError("decode root recheck failed")
+            delivered.append(join_payload(dec_data[i]))
+        stats["rbc_decode_s"] = time.perf_counter() - t0
+
+        # ---- BBA: every instance gets input 1 (all RBCs delivered);
+        # vals == {1} each round, so the instance decides when its real
+        # threshold coin tosses 1 (docs/BBA-EN.md:163-181)
+        t0 = time.perf_counter()
+        coin_pub = self.coin.pub
+        coin_vks = coin_pub.verification_keys
+        undecided = list(range(n))
+        rounds_used = 0
+        coin_issues = 0
+        coin_verifies = 0
+        for rnd in range(MAX_COIN_ROUNDS):
+            if not undecided:
+                break
+            rounds_used = rnd + 1
+            # every node issues its share for every undecided instance
+            items = []
+            metas = []
+            for inst in undecided:
+                coin_id = b"%d|%s|%d" % (
+                    self.epoch,
+                    ids[inst].encode(),
+                    rnd,
+                )
+                pub, base, context = self.coin.group_params(coin_id)
+                metas.append((inst, coin_id, pub, base, context))
+                for nid in ids:
+                    sec = self.keys[nid].coin_share
+                    items.append(
+                        (sec, base, context, coin_vks[sec.index - 1])
+                    )
+            shares = issue_shares_batch(
+                items, group=group, backend=backend, mesh=mesh
+            )
+            coin_issues += len(items)
+            # receivers verify the first f+1 pooled shares per
+            # instance (the honest-case minimum), one dispatch
+            groups = []
+            subsets = []
+            for mi, (inst, coin_id, pub, base, context) in enumerate(
+                metas
+            ):
+                sub = shares[mi * n : mi * n + (f + 1)]
+                subsets.append(sub)
+                groups.append((pub, base, sub, context))
+            verdicts = verify_share_groups(
+                groups, backend=backend, mesh=mesh
+            )
+            coin_verifies += sum(len(v) for v in verdicts)
+            if not all(all(v) for v in verdicts):
+                raise AssertionError("honest coin share failed CP check")
+            # combine (one dispatch; primes the combine memo) + toss
+            combine_shares_batch(
+                subsets,
+                coin_pub.threshold,
+                group=group,
+                backend=backend,
+                mesh=mesh,
+            )
+            still = []
+            for (inst, coin_id, _pub, _base, _ctx), sub in zip(
+                metas, subsets
+            ):
+                if not self.coin.toss(coin_id, sub):  # memo hit
+                    still.append(inst)
+            undecided = still
+        if undecided:
+            raise AssertionError(
+                f"instances undecided after {MAX_COIN_ROUNDS} rounds"
+            )
+        stats["bba_s"] = time.perf_counter() - t0
+        stats["bba_rounds"] = rounds_used
+        stats["coin_issues"] = coin_issues
+        stats["coin_verifies"] = coin_verifies
+
+        # ---- decrypt: N^2 share issues + N optimistic combines ----
+        t0 = time.perf_counter()
+        tpke_pub = self.tpke.pub
+        tpke_vks = tpke_pub.verification_keys
+        cts = [deserialize_ciphertext(v, group) for v in delivered]
+        items = []
+        for ct in cts:
+            context = self.tpke.context(ct)
+            for nid in ids:
+                sec = self.keys[nid].tpke_share
+                items.append(
+                    (sec, ct.c1, context, tpke_vks[sec.index - 1])
+                )
+        dec_shares = issue_shares_batch(
+            items, group=group, backend=backend, mesh=mesh
+        )
+        # optimistic combine (protocol.honeybadger._try_decrypt): the
+        # ciphertext tag authenticates the KEM value, so the honest
+        # case spends zero CP verifications on decryption shares
+        subsets = [
+            dec_shares[i * n : i * n + tpke_pub.threshold]
+            for i in range(n)
+        ]
+        combine_shares_batch(
+            subsets,
+            tpke_pub.threshold,
+            group=group,
+            backend=backend,
+            mesh=mesh,
+        )
+        decrypted: Dict[str, List[bytes]] = {}
+        for i, (ct, sub) in enumerate(zip(cts, subsets)):
+            plain = self.tpke.combine(ct, sub)  # memo hit + tag check
+            decrypted[ids[i]] = deserialize_txs(plain)
+        stats["decrypt_s"] = time.perf_counter() - t0
+        stats["dec_issues"] = len(items)
+
+        # ---- commit: the reference dedup/ordering rule ----
+        # (protocol.honeybadger._maybe_commit)
+        t0 = time.perf_counter()
+        seen: set = set()
+        contributions: Dict[str, List[bytes]] = {}
+        for proposer in sorted(decrypted):
+            mine = []
+            for tx in decrypted[proposer]:
+                if tx not in seen:
+                    seen.add(tx)
+                    mine.append(tx)
+            if mine:
+                contributions[proposer] = mine
+        self.committed_batches.append(Batch(contributions=contributions))
+        stats["commit_s"] = time.perf_counter() - t0
+
+        stats["epoch_s"] = time.perf_counter() - t_all
+        self.epoch += 1
+        self.last_stats = stats
+        return stats
+
+    def run_epochs(self, max_epochs: int = 50) -> int:
+        """Drive epochs until every queue drains (or the cap)."""
+        for e in range(max_epochs):
+            self.run_epoch()
+            if self.pending_tx_count() == 0:
+                return e + 1
+        return max_epochs
+
+
+__all__ = ["LockstepCluster", "MAX_COIN_ROUNDS"]

@@ -102,3 +102,4 @@ def test_hbbft_epoch_on_cpp_backend():
         hb.start_epoch()
     net.run()
     assert_identical_batches(nodes)
+
