@@ -255,6 +255,45 @@ def measure_protocol(backend: str, n: int, batch: int, epochs: int) -> dict:
     }
 
 
+def measure_spmd(backend: str, n: int, batch: int, epochs: int) -> dict:
+    """Full-protocol lockstep epochs (protocol.spmd.LockstepCluster):
+    every epoch performs the complete deduplicated cryptographic work
+    of an N-validator HBBFT epoch — real RS/Merkle/branch-verify, real
+    threshold coin per BBA round, optimistic threshold decryption —
+    under the benign synchronous schedule (see the module docstring
+    for exactly what is and is not exercised)."""
+    from cleisthenes_tpu.protocol.spmd import LockstepCluster
+
+    cluster = LockstepCluster(
+        n=n, batch_size=batch, crypto_backend=backend, key_seed=77
+    )
+    rng = np.random.default_rng(13)
+    total = (batch // n) * n * (epochs + 1)
+    for _ in range(total):
+        tx = rng.integers(0, 256, size=TX_BYTES, dtype=np.uint8).tobytes()
+        cluster.submit(tx)
+    cluster.run_epoch()  # warm-up (compiles)
+    times = []
+    committed = 0
+    rounds = []
+    for _ in range(epochs):
+        before = len(cluster.committed_batches)
+        s = cluster.run_epoch()
+        times.append(s["epoch_s"])
+        rounds.append(s["bba_rounds"])
+        committed += sum(
+            len(b) for b in cluster.committed_batches[before:]
+        )
+    p50 = statistics.median(times)
+    total_t = sum(times)
+    return {
+        "epoch_p50_ms": round(p50 * 1000.0, 3),
+        "tx_per_sec": round(committed / total_t, 1) if total_t else None,
+        "measured_epochs": epochs,
+        "bba_rounds": rounds,
+    }
+
+
 def _vs(cpu_ms, tpu_ms):
     """cpu/tpu ratio, None-safe and NaN-safe (ADVICE round-2)."""
     if (
@@ -475,6 +514,20 @@ def run_child() -> None:
         out[name] = protocol_section(
             "tpu", cpu_ref, pc["n"], pc["batch"], pc["epochs"]
         )
+    # full-protocol lockstep epochs at the BASELINE config-4 scale
+    # (N=128, f=42, 10k-tx batches) — the SPMD executor
+    progress("protocol_spmd_n128 tpu")
+    spmd_tpu = measure_spmd("tpu", 128, 10_000, 3)
+    progress("protocol_spmd_n128 cpu")
+    spmd_cpu = measure_spmd(cpu_ref, 128, 10_000, 3)
+    out["protocol_spmd_n128"] = {
+        "n": 128, "f": 42, "batch": 10_000,
+        "mode": "lockstep (protocol.spmd; benign synchronous schedule, "
+                "full dedup'd crypto, wire/MAC layer not exercised)",
+        "tpu": spmd_tpu,
+        "cpu": spmd_cpu,
+        "vs_cpu": _vs(spmd_cpu["epoch_p50_ms"], spmd_tpu["epoch_p50_ms"]),
+    }
     progress("crypto_n512_pipelined tpu")
     out["crypto_n512_pipelined"] = {
         "tpu": measure_n512_pipelined("tpu"),

@@ -258,34 +258,43 @@ def issue_shares_batch(
     )
     q, g = group.q, group.g
     nbytes = group.nbytes
+    # Exponentiations grouped by base — a wave shares a handful of
+    # bases (the generator g plus one coin base / ciphertext c1 per
+    # instance), which is exactly the fixed-base comb kernel's shape
+    # (ModEngine.pow_batch_grouped).
     ws = []
-    bases_flat: List[int] = []
-    exps_flat: List[int] = []
+    g_exps: List[int] = []
+    by_base: Dict[int, List[int]] = {}
     for share, base, _context, vk in items:
         w = (
             int.from_bytes(secrets.token_bytes(nbytes + 8), "big") % q
         )  # unbiased nonce: same rule (and reason) as issue_share
         ws.append(w)
-        bases_flat.append(g)
-        exps_flat.append(w)  # a1 = g^w
-        bases_flat.append(base)
-        exps_flat.append(w)  # a2 = base^w
-        bases_flat.append(base)
-        exps_flat.append(share.value)  # d = base^{s_i}
+        g_exps.append(w)  # a1 = g^w
         if vk is None:
-            bases_flat.append(g)
-            exps_flat.append(share.value)  # h_i = g^{s_i}
-    pows = eng.pow_batch(bases_flat, exps_flat)
+            g_exps.append(share.value)  # h_i = g^{s_i}
+        be = by_base.setdefault(base, [])
+        be.append(w)  # a2 = base^w
+        be.append(share.value)  # d = base^{s_i}
+    base_order = list(by_base)
+    groups = [(g, g_exps)] + [(b, by_base[b]) for b in base_order]
+    pows = eng.pow_batch_grouped(groups)
+    g_res = pows[0]
+    base_res = {b: res for b, res in zip(base_order, pows[1:])}
+    base_off = {b: 0 for b in base_order}
     out: List[DhShare] = []
-    off = 0
+    g_off = 0
     for (share, base, context, vk), w in zip(items, ws):
-        a1, a2, d = pows[off], pows[off + 1], pows[off + 2]
-        off += 3
+        a1 = g_res[g_off]
+        g_off += 1
         if vk is None:
-            hi = pows[off]
-            off += 1
+            hi = g_res[g_off]
+            g_off += 1
         else:
             hi = vk
+        bo = base_off[base]
+        a2, d = base_res[base][bo], base_res[base][bo + 1]
+        base_off[base] = bo + 2
         e = (
             _hash_to_int(
                 b"cp", context, _ibytes(base, nbytes), _ibytes(hi, nbytes),

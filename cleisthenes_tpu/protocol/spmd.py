@@ -72,6 +72,15 @@ from cleisthenes_tpu.protocol.honeybadger import (
 # A round decides with probability 1/2 per instance; 64 rounds is
 # P ~ 2^-64 per instance — the same class of bound as bba.MAX_ROUNDS.
 MAX_COIN_ROUNDS = 64
+# Coin rounds precomputed speculatively in one batched block (see the
+# BBA section of run_epoch): covers 1 - 2^-SPEC_ROUNDS of instances.
+# Measured on the axon relay, speculation past round 0 LOSES: it
+# doubles the exponentiation mass and promotes the tail rounds'
+# verify/combine batches off the native host floor, costing more than
+# the saved round-trips (3.0 s -> 3.8 s per N=128 epoch at 4).  On a
+# locally-attached chip with sub-ms dispatch the trade flips; the knob
+# stays for that deployment shape.
+SPEC_ROUNDS = 1
 
 
 class LockstepCluster:
@@ -214,34 +223,45 @@ class LockstepCluster:
 
         # ---- BBA: every instance gets input 1 (all RBCs delivered);
         # vals == {1} each round, so the instance decides when its real
-        # threshold coin tosses 1 (docs/BBA-EN.md:163-181)
+        # threshold coin tosses 1 (docs/BBA-EN.md:163-181).
+        #
+        # Rounds 0..SPEC_ROUNDS-1 run SPECULATIVELY in one issue + one
+        # verify + one combine dispatch: a round-r coin share is a
+        # deterministic VUF of (epoch, proposer, r), independent of any
+        # protocol state, so a node may precompute shares for rounds it
+        # might never reach — trading a bounded amount of wasted
+        # exponentiation (expected 2x of the minimum; every instance's
+        # round count is geometric) for an 8x cut in sequential device
+        # round-trips.  Stragglers past the window fall back to the
+        # per-round path (tiny batches, host-floored to the native
+        # kernel).
         t0 = time.perf_counter()
         coin_pub = self.coin.pub
         coin_vks = coin_pub.verification_keys
-        undecided = list(range(n))
         rounds_used = 0
         coin_issues = 0
         coin_verifies = 0
-        for rnd in range(MAX_COIN_ROUNDS):
-            if not undecided:
-                break
-            rounds_used = rnd + 1
-            # every node issues its share for every undecided instance
+        undecided = list(range(n))
+        coin_bits: Dict[tuple, bool] = {}  # (inst, rnd) -> toss
+
+        def run_rounds(rnd_list, inst_list):
+            """Issue + verify + combine + toss for every (inst, rnd)
+            pair, three dispatches total; fills coin_bits."""
+            nonlocal coin_issues, coin_verifies
             items = []
             metas = []
-            for inst in undecided:
-                coin_id = b"%d|%s|%d" % (
-                    self.epoch,
-                    ids[inst].encode(),
-                    rnd,
-                )
-                pub, base, context = self.coin.group_params(coin_id)
-                metas.append((inst, coin_id, pub, base, context))
-                for nid in ids:
-                    sec = self.keys[nid].coin_share
-                    items.append(
-                        (sec, base, context, coin_vks[sec.index - 1])
+            for rnd in rnd_list:
+                for inst in inst_list:
+                    coin_id = b"%d|%s|%d" % (
+                        self.epoch, ids[inst].encode(), rnd,
                     )
+                    pub, base, context = self.coin.group_params(coin_id)
+                    metas.append((inst, rnd, coin_id, pub, base, context))
+                    for nid in ids:
+                        sec = self.keys[nid].coin_share
+                        items.append(
+                            (sec, base, context, coin_vks[sec.index - 1])
+                        )
             shares = issue_shares_batch(
                 items, group=group, backend=backend, mesh=mesh
             )
@@ -250,7 +270,7 @@ class LockstepCluster:
             # instance (the honest-case minimum), one dispatch
             groups = []
             subsets = []
-            for mi, (inst, coin_id, pub, base, context) in enumerate(
+            for mi, (inst, rnd, coin_id, pub, base, context) in enumerate(
                 metas
             ):
                 sub = shares[mi * n : mi * n + (f + 1)]
@@ -262,7 +282,6 @@ class LockstepCluster:
             coin_verifies += sum(len(v) for v in verdicts)
             if not all(all(v) for v in verdicts):
                 raise AssertionError("honest coin share failed CP check")
-            # combine (one dispatch; primes the combine memo) + toss
             combine_shares_batch(
                 subsets,
                 coin_pub.threshold,
@@ -270,13 +289,19 @@ class LockstepCluster:
                 backend=backend,
                 mesh=mesh,
             )
-            still = []
-            for (inst, coin_id, _pub, _base, _ctx), sub in zip(
-                metas, subsets
-            ):
-                if not self.coin.toss(coin_id, sub):  # memo hit
-                    still.append(inst)
-            undecided = still
+            for (inst, rnd, coin_id, *_rest), sub in zip(metas, subsets):
+                coin_bits[(inst, rnd)] = self.coin.toss(coin_id, sub)
+
+        run_rounds(range(SPEC_ROUNDS), undecided)  # the speculative block
+        for rnd in range(MAX_COIN_ROUNDS):
+            if not undecided:
+                break
+            rounds_used = rnd + 1
+            if (undecided[0], rnd) not in coin_bits:
+                run_rounds([rnd], undecided)  # past the window: tiny
+            undecided = [
+                inst for inst in undecided if not coin_bits[(inst, rnd)]
+            ]
         if undecided:
             raise AssertionError(
                 f"instances undecided after {MAX_COIN_ROUNDS} rounds"
