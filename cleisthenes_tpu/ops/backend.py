@@ -17,16 +17,24 @@ import numpy as np
 
 
 class ErasureCoder(abc.ABC):
-    """Systematic (n, k) Reed-Solomon codec over GF(2^8).
+    """Systematic (n, k) Reed-Solomon codec.
 
     Shards are byte matrices: ``data`` is (k, L), full shard sets are
     (n, L) with rows 0..k-1 the data shards and rows k..n-1 parity
     (reference rbc/rbc.go:98-100 `shard`, :88-90 `interpolate`).
+
+    ``MAX_N`` is the field's shard-index ceiling: 256 for the GF(2^8)
+    coders (the same hard limit as the reference's codec dependency),
+    65536 for the GF(2^16) coders that lift it (ops/gf65536.py).
     """
 
+    MAX_N = 256
+
     def __init__(self, n: int, k: int):
-        if not (1 <= k <= n <= 256):
-            raise ValueError(f"need 1 <= k <= n <= 256, got n={n} k={k}")
+        if not (1 <= k <= n <= self.MAX_N):
+            raise ValueError(
+                f"need 1 <= k <= n <= {self.MAX_N}, got n={n} k={k}"
+            )
         self.n = n
         self.k = k
 
@@ -79,6 +87,21 @@ class ErasureCoder(abc.ABC):
 def make_erasure_coder(
     backend: str, n: int, k: int, mesh=None
 ) -> ErasureCoder:
+    if n > 256:
+        # past the GF(2^8) shard-index ceiling (the reference's hard
+        # limit): the GF(2^16) coders.  The native C++ kernel is
+        # 8-bit-only, so 'cpp' serves these rosters from the host
+        # reference path.
+        from cleisthenes_tpu.ops.rs16 import (
+            Cpu16ErasureCoder,
+            Xla16ErasureCoder,
+        )
+
+        if backend in ("cpu", "cpp"):
+            return Cpu16ErasureCoder(n, k)
+        if backend == "tpu":
+            return Xla16ErasureCoder(n, k, mesh=mesh)
+        raise ValueError(f"unknown erasure backend {backend!r}")
     if backend == "cpu":
         from cleisthenes_tpu.ops.rs_cpu import CpuErasureCoder
 

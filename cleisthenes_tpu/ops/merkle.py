@@ -192,12 +192,15 @@ class XlaMerkle(MerkleBackend):
     """
 
     # Below this batch size the device round-trip costs more than the
-    # hashes: scalar/small jobs (a VAL's single branch proof, one
-    # proposer's tree) run on host, batch waves run on device.  Under
-    # a remote relay a dispatch is ~30-100 ms; 16 branch hashes are
-    # ~20 us of hashlib.
-    HOST_FLOOR_VERIFY = 16
-    HOST_FLOOR_BUILD = 4
+    # hashes: small jobs run on host, batch waves run on device.
+    # Host hashlib SHA-256 is ~0.7 us/hash; a relay dispatch is
+    # ~40 ms round-trip, so the crossover sits near 8k branch proofs
+    # (~7 hashes each) / 16k forest leaves (~2 hashes each).  An
+    # N=16 live epoch's whole merkle load therefore stays native
+    # (it is microseconds of hashing), while the N>=128 crypto-plane
+    # waves (16k+ items) take the device path.
+    HOST_FLOOR_VERIFY = 8192
+    HOST_FLOOR_BUILD_LEAVES = 16384
 
     def __init__(self, mesh=None):
         self._mesh = mesh
@@ -228,6 +231,11 @@ class XlaMerkle(MerkleBackend):
         from cleisthenes_tpu.ops.sha256_xla import sha256_batch
 
         b = msgs.shape[0]
+        if b < self.HOST_FLOOR_VERIFY:
+            # also covers the base-class single-tree build(): a
+            # 16-leaf tree is ~5 per-level dispatches on device vs
+            # ~10 us of hashlib
+            return self._host._hash_batch(msgs)
         bucket = self._bucket(b)
         if bucket != b:
             msgs = np.concatenate(
@@ -239,7 +247,7 @@ class XlaMerkle(MerkleBackend):
         from cleisthenes_tpu.ops.sha256_xla import build_forest
 
         b, n, _ = shards.shape
-        if b * n < self.HOST_FLOOR_BUILD * 8:
+        if b * n < self.HOST_FLOOR_BUILD_LEAVES:
             return self._host.build_batch(shards)
         bucket = self._bucket(b)
         if bucket != b:

@@ -1,0 +1,60 @@
+"""Jitted GF(2^16) RS kernels: 16-bit-plane lifted matmuls.
+
+Separate module so ops/rs16.py stays importable (and its CPU coder
+usable) without touching JAX.  Structure mirrors ops/rs_xla.py's
+8-bit kernels with uint16 symbols and 16 bit-planes per symbol.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+E = 16
+
+
+def _unpack_bits16(x: jnp.ndarray) -> jnp.ndarray:
+    """(r, S) uint16 -> (16r, S) bf16 bit-planes, LSB-first."""
+    r, s = x.shape
+    shifts = jnp.arange(E, dtype=jnp.uint16)
+    bits = (x[:, None, :] >> shifts[None, :, None]) & jnp.uint16(1)
+    return bits.reshape(E * r, s).astype(jnp.bfloat16)
+
+
+def _pack_bits16(bits: jnp.ndarray) -> jnp.ndarray:
+    """(16r, S) integer 0/1 -> (r, S) uint16."""
+    r16, s = bits.shape
+    b = bits.reshape(r16 // E, E, s).astype(jnp.uint32)
+    weights = (jnp.uint32(1) << jnp.arange(E, dtype=jnp.uint32))[
+        None, :, None
+    ]
+    return (b * weights).sum(axis=1).astype(jnp.uint16)
+
+
+def _apply_bits16(g_bits: jnp.ndarray, syms: jnp.ndarray) -> jnp.ndarray:
+    """Apply a lifted GF(2^16) matrix: (16m,16k) x (k,S) -> (m,S).
+
+    Dots sum <= 16k ones — exact in bf16 multiply / f32 accumulate."""
+    acc = jnp.dot(
+        g_bits.astype(jnp.bfloat16),
+        _unpack_bits16(syms),
+        preferred_element_type=jnp.float32,
+    )
+    return _pack_bits16(acc.astype(jnp.int32) & 1)
+
+
+@jax.jit
+def _encode_kernel(g_bits, syms):
+    parity = _apply_bits16(g_bits, syms)
+    return jnp.concatenate([syms, parity], axis=0)
+
+
+@jax.jit
+def _decode_kernel(g_bits, syms):
+    return _apply_bits16(g_bits, syms)
+
+
+encode_kernel_batch = jax.jit(jax.vmap(_encode_kernel, in_axes=(None, 0)))
+decode_kernel_shared = jax.jit(jax.vmap(_decode_kernel, in_axes=(None, 0)))
+
+__all__ = ["encode_kernel_batch", "decode_kernel_shared"]
