@@ -89,3 +89,18 @@ def test_lockstep_conflicting_config_rejected():
 
     with pytest.raises(ValueError):
         LockstepCluster(n=7, config=Config(n=4, batch_size=16))
+
+
+def test_lockstep_roster_past_gf256_ceiling():
+    """n > 256 forces the GF(2^16) codec inside the full protocol —
+    a roster the reference's codec dependency cannot express (256
+    total shards).  Kept small-batch; the epoch still runs every
+    phase (RS-16 encode/decode, 2^9-leaf Merkle forest, threshold
+    coin at f=85, optimistic decryption) for all 257 validators."""
+    c = LockstepCluster(n=257, batch_size=257, key_seed=13)
+    for i in range(257):
+        c.submit(_tx(i))
+    c.run_epoch()
+    got = _committed_txs(c.committed())
+    assert got == {_tx(i) for i in range(257)}
+    assert c.crypto.erasure.MAX_N == 1 << 16
