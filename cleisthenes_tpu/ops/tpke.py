@@ -390,24 +390,14 @@ def verify_share_groups(
         eng = get_engine(
             backend if gp.p.bit_length() <= 256 else "cpu", mesh, gp
         )
-        u1, e1, u2, e2 = [], [], [], []
-        for gi in idx_list:
-            pub, base, shares, _context = groups[gi]
-            for sh in shares:
-                if not (1 <= sh.index <= pub.n):
-                    # out-of-roster index: verified vacuously false by
-                    # pinning to vk=1 (never matches a real transcript)
-                    hi = 1
-                else:
-                    hi = pub.verification_keys[sh.index - 1]
-                neg_e = (-sh.e) % gp.q
-                # A1 = g^z * hi^{-e}
-                u1.append(gp.g); e1.append(sh.z % gp.q)
-                u2.append(hi); e2.append(neg_e)
-                # A2 = base^z * d^{-e}
-                u1.append(base); e1.append(sh.z % gp.q)
-                u2.append(sh.d % gp.p); e2.append(neg_e)
-        a = eng.dual_pow_batch(u1, e1, u2, e2)
+        # NOTE: a comb-decomposed variant (g^z, h^{-e}, base^z grouped
+        # fixed-base; d^{-e} generic; host recombination) was measured
+        # SLOWER than this fused path at 4k checks (0.23 s vs 0.12 s
+        # warm on the v5e relay): Shamir's trick already shares the
+        # square chain between both factors of each dual, so the
+        # decomposition saves fewer multiplies than it spends on extra
+        # dispatches and host marshalling.
+        a = _verify_pows_dual(gp, eng, groups, idx_list)
         off = 0
         nb = gp.nbytes
         for gi in idx_list:
@@ -430,6 +420,29 @@ def verify_share_groups(
                 res.append(e_want == sh.e % gp.q)
             results[gi] = res
     return [results[gi] for gi in range(len(groups))]
+
+
+def _verify_pows_dual(gp, eng, groups, idx_list) -> List[int]:
+    """(A1, A2) per share via the fused dual-exponentiation kernel —
+    the host path and the small-batch device path."""
+    u1, e1, u2, e2 = [], [], [], []
+    for gi in idx_list:
+        pub, base, shares, _context = groups[gi]
+        for sh in shares:
+            if not (1 <= sh.index <= pub.n):
+                # out-of-roster index: verified vacuously false by
+                # pinning to vk=1 (never matches a real transcript)
+                hi = 1
+            else:
+                hi = pub.verification_keys[sh.index - 1]
+            neg_e = (-sh.e) % gp.q
+            # A1 = g^z * hi^{-e}
+            u1.append(gp.g); e1.append(sh.z % gp.q)
+            u2.append(hi); e2.append(neg_e)
+            # A2 = base^z * d^{-e}
+            u1.append(base); e1.append(sh.z % gp.q)
+            u2.append(sh.d % gp.p); e2.append(neg_e)
+    return eng.dual_pow_batch(u1, e1, u2, e2)
 
 
 def verify_shares(
