@@ -10,11 +10,22 @@ seam.
 
 from cleisthenes_tpu.protocol.acs import ACS
 from cleisthenes_tpu.protocol.bba import BBA
+from cleisthenes_tpu.protocol.cluster import SimulatedCluster
 from cleisthenes_tpu.protocol.honeybadger import (
     HoneyBadger,
     NodeKeys,
     setup_keys,
 )
 from cleisthenes_tpu.protocol.rbc import RBC
+from cleisthenes_tpu.protocol.spmd import LockstepCluster
 
-__all__ = ["RBC", "BBA", "ACS", "HoneyBadger", "NodeKeys", "setup_keys"]
+__all__ = [
+    "RBC",
+    "BBA",
+    "ACS",
+    "HoneyBadger",
+    "NodeKeys",
+    "setup_keys",
+    "SimulatedCluster",
+    "LockstepCluster",
+]
