@@ -487,29 +487,11 @@ def run_child() -> None:
         ),
     }
     for name, pc in PROTO_CONFIGS.items():
-        if name == "protocol_n64" and not (
-            on_tpu and os.environ.get("BENCH_FULL") == "1"
-        ):
-            # The accelerated n64 protocol run is opt-in (BENCH_FULL=1
-            # on a healthy relay): without a TPU the XLA-on-host-CPU
-            # Montgomery kernels are a degraded stand-in, and WITH the
-            # remote relay the ~2k per-wave dispatches x ~0.1 s RTT
-            # put the section past any sane bench budget.  The
-            # accelerated path's scaling story lives in protocol_n16 +
-            # the crypto-plane sections; n64 records the CPU-native
-            # protocol numbers either way.
-            cpu = measure_protocol(cpu_ref, pc["n"], pc["batch"],
-                                   pc["epochs"])
-            out[name] = {
-                "n": pc["n"], "batch": pc["batch"], "cpu": cpu,
-                "tpu": None, "vs_cpu": None,
-                "note": (
-                    "accelerated side skipped: "
-                    + ("BENCH_FULL!=1 (relay dispatch RTT dominates)"
-                       if on_tpu else "no TPU attached (cpu fallback)")
-                ),
-            }
-            continue
+        # Both backends run every live-protocol section: the host
+        # floors (ModEngine.HOST_FLOOR, XlaMerkle.HOST_FLOOR_*) route
+        # sub-crossover batches to the native kernels, so the 'tpu'
+        # backend no longer drowns small-N waves in per-dispatch RTT
+        # (the round-2 failure mode that made n64-accelerated opt-in).
         progress(name)
         out[name] = protocol_section(
             "tpu", cpu_ref, pc["n"], pc["batch"], pc["epochs"]

@@ -215,9 +215,19 @@ class BBA:
         """Coin share without a payload object (columnar batch path)."""
         if self.halted or sender not in self._member_set:
             return
+        self.handle_coin_fast(sender, rnd, index, d, e, z)
+
+    def handle_coin_fast(
+        self, sender: str, rnd: int, index: int, d: int, e: int, z: int
+    ) -> None:
+        """handle_coin minus the halted/membership gate — for callers
+        that already checked both (ACS.handle_coin_batch hoists them
+        out of its per-instance loop)."""
         if rnd == self.round:
             self._handle_coin_share_scalar(sender, index, d, e, z)
             return
+        if rnd < self.round:
+            return  # stale: skip the payload allocation
         self._gated(
             sender,
             CoinPayload(self.proposer, self.epoch, rnd, index, d, e, z),
