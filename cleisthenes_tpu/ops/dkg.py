@@ -1,0 +1,354 @@
+"""Distributed key generation: threshold keys without the dealer.
+
+The one trust assumption this framework inherits from the reference's
+design docs is the trusted dealer (reference
+docs/THRESHOLD_ENCRYPTION-EN.md:33 assumes "SetUp" hands out shares;
+ops/tpke.py's ``deal`` implements exactly that).  This module removes
+it: Joint-Feldman DKG over the same prime-order group, where every
+participant acts as a dealer of a random secret and the final key is
+the sum of the QUALIFIED dealings.
+
+Per participant i (threshold t, roster 1..n):
+
+  1. sample f_i(x) = a_i0 + a_i1 x + ... + a_i,t-1 x^(t-1) over Z_q
+  2. broadcast Feldman commitments C_ik = g^{a_ik}  (k < t)
+  3. send s_ij = f_i(j) to participant j over a private channel
+  4. j accepts iff g^{s_ij} == prod_k C_ik^{j^k}  (verify_dealer_share)
+  5. dealers with any valid complaint are disqualified; the qualified
+     set Q survives, and j's final share is x_j = sum_{i in Q} s_ij,
+     the master key h = prod_{i in Q} C_i0, and every verification key
+     h_j = prod_{i in Q} prod_k C_ik^{j^k} is PUBLICLY computable —
+     so the output is a drop-in ``ThresholdPublicKey`` +
+     ``ThresholdSecretShare`` pair for TPKE and the common coin.
+
+Security note (documented, deliberate): plain Joint-Feldman lets a
+rushing adversary bias the distribution of the final public key
+(Gennaro, Jarecki, Krawczyk, Rabin 1999); the fix is their two-phase
+variant with Pedersen commitments in phase one.  The bias does not
+affect secrecy of the shares — only uniformity of the key — and the
+phase structure here (deal -> verify -> complain -> finalize over the
+same commitment algebra) is exactly the skeleton that variant slots
+into.  The share transport must be private: this module produces and
+verifies the protocol's VALUES and leaves carriage to the caller
+(tests drive it in-process; a deployment would wrap shares in a
+key-agreed channel).
+
+All verification exponentiations batch through the ModEngine seam —
+one ``pow_batch`` for a whole roster's share checks, one for the full
+verification-key table — same as every other crypto plane in ops/.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import secrets as _secrets
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from cleisthenes_tpu.ops.modmath import (
+    DEFAULT_GROUP,
+    GroupParams,
+    get_engine,
+)
+from cleisthenes_tpu.ops.tpke import (
+    ThresholdPublicKey,
+    ThresholdSecretShare,
+)
+
+
+class DkgDealing:
+    """One participant's dealer role: polynomial + commitments + the
+    per-receiver shares."""
+
+    def __init__(
+        self,
+        dealer_index: int,
+        n: int,
+        threshold: int,
+        group: GroupParams = DEFAULT_GROUP,
+        seed: Optional[int] = None,
+    ) -> None:
+        if not (1 <= threshold <= n):
+            raise ValueError(f"need 1 <= t <= n, got t={threshold} n={n}")
+        self.dealer_index = dealer_index
+        self.n = n
+        self.threshold = threshold
+        self.group = group
+        q = group.q
+        nb = group.nbytes + 8  # excess bytes: unbiased mod-q samples
+        if seed is None:
+            rnd = _secrets.token_bytes
+        else:
+            ctr = [0]
+
+            def rnd(k: int, _s=seed, _d=dealer_index) -> bytes:
+                out = b""
+                while len(out) < k:
+                    ctr[0] += 1
+                    out += hashlib.sha256(
+                        b"dkg|%d|%d|%d" % (_s, _d, ctr[0])
+                    ).digest()
+                return out[:k]
+
+        self._coeffs = [
+            int.from_bytes(rnd(nb), "big") % q for _ in range(threshold)
+        ]
+
+    def commitments(self, backend: str = "cpu", mesh=None) -> List[int]:
+        """Feldman commitments C_k = g^{a_k} — broadcast publicly."""
+        gp = self.group
+        eng = get_engine(
+            backend if gp.p.bit_length() <= 256 else "cpu", mesh, gp
+        )
+        return eng.pow_batch([gp.g] * len(self._coeffs), self._coeffs)
+
+    def share_for(self, receiver_index: int) -> int:
+        """s_ij = f_i(j) — send PRIVATELY to participant j (1-based)."""
+        if not (1 <= receiver_index <= self.n):
+            raise ValueError(f"receiver index {receiver_index} out of roster")
+        q = self.group.q
+        acc = 0
+        for c in reversed(self._coeffs):
+            acc = (acc * receiver_index + c) % q
+        return acc
+
+
+def _commit_eval_exps(
+    j: int, threshold: int, q: int
+) -> List[int]:
+    """[j^k mod q for k < threshold] — the exponents of the commitment
+    product at evaluation point j."""
+    out = [1]
+    for _ in range(threshold - 1):
+        out.append(out[-1] * j % q)
+    return out
+
+
+def validate_commitments(
+    commitment_sets: Sequence[Sequence[int]],
+    group: GroupParams = DEFAULT_GROUP,
+    backend: str = "cpu",
+    mesh=None,
+) -> List[bool]:
+    """Subgroup membership for whole commitment vectors, batched.
+
+    REQUIRED before any exponent arithmetic on a dealer's broadcast:
+    the verification equation reduces exponents mod q, which is sound
+    only for order-q elements.  A malicious dealer broadcasting a
+    commitment with an order-2 component would otherwise verify
+    INCONSISTENTLY across receivers (the reduced exponent's parity
+    differs per evaluation point), splitting honest nodes' qualified
+    sets — an agreement break, not just a bad key.  Membership is a
+    deterministic property of the broadcast bytes, so every honest
+    node disqualifies the same dealers."""
+    gp = group
+    eng = get_engine(
+        backend if gp.p.bit_length() <= 256 else "cpu", mesh, gp
+    )
+    flat: List[int] = []
+    spans: List[int] = []
+    for commits in commitment_sets:
+        flat.extend(c % gp.p for c in commits)
+        spans.append(len(commits))
+    pows = eng.pow_batch(flat, [gp.q] * len(flat))
+    out: List[bool] = []
+    off = 0
+    for (commits, span) in zip(commitment_sets, spans):
+        ok = all(
+            1 < (c % gp.p) and pows[off + i] == 1
+            for i, c in enumerate(commits)
+        )
+        off += span
+        out.append(ok)
+    return out
+
+
+def verify_dealer_shares(
+    items: Sequence[tuple],
+    group: GroupParams = DEFAULT_GROUP,
+    backend: str = "cpu",
+    mesh=None,
+) -> List[bool]:
+    """Batched step-4 checks: ``items`` is a sequence of
+    ``(commitments, receiver_index, share)`` and every
+    g^{s} == prod_k C_k^{j^k} test runs from two batched dispatches.
+
+    Callers must have validated the commitment vectors first
+    (validate_commitments) — the j^k exponents here are reduced mod q,
+    which assumes order-q elements."""
+    if not items:
+        return []
+    gp = group
+    eng = get_engine(
+        backend if gp.p.bit_length() <= 256 else "cpu", mesh, gp
+    )
+    bases: List[int] = []
+    exps: List[int] = []
+    spans: List[int] = []
+    for commitments, j, share in items:
+        t = len(commitments)
+        jk = _commit_eval_exps(j, t, gp.q)
+        bases.extend(c % gp.p for c in commitments)
+        exps.extend(jk)
+        bases.append(gp.g)
+        exps.append(share % gp.q)
+        spans.append(t + 1)
+    pows = eng.pow_batch(bases, exps)
+    out: List[bool] = []
+    off = 0
+    for span in spans:
+        prod = 1
+        for v in pows[off : off + span - 1]:
+            prod = prod * v % gp.p
+        lhs = pows[off + span - 1]  # g^{share}
+        off += span
+        out.append(lhs == prod)
+    return out
+
+
+def finalize(
+    all_commitments: Dict[int, Sequence[int]],
+    my_index: int,
+    my_shares: Dict[int, int],
+    n: int,
+    threshold: int,
+    group: GroupParams = DEFAULT_GROUP,
+    backend: str = "cpu",
+    mesh=None,
+) -> Tuple[ThresholdPublicKey, ThresholdSecretShare]:
+    """Fold the qualified dealings into this node's final key pair.
+
+    ``all_commitments``: dealer index -> its t commitments (the
+    qualified set Q — callers exclude disqualified dealers from BOTH
+    arguments).  ``my_shares``: dealer index -> s_{i,my_index}.  Every
+    correct node derives the IDENTICAL public key because the inputs
+    are the broadcast commitments alone."""
+    if set(all_commitments) != set(my_shares):
+        raise ValueError("commitment/share dealer sets differ")
+    if not all_commitments:
+        raise ValueError("empty qualified set")
+    gp = group
+    eng = get_engine(
+        backend if gp.p.bit_length() <= 256 else "cpu", mesh, gp
+    )
+    x_j = sum(my_shares.values()) % gp.q
+    master = 1
+    for commits in all_commitments.values():
+        master = master * (commits[0] % gp.p) % gp.p
+    # the full verification-key table h_m = prod_{i,k} C_ik^{m^k},
+    # one batched dispatch for all n receivers x |Q| dealers x t terms
+    bases: List[int] = []
+    exps: List[int] = []
+    for m in range(1, n + 1):
+        jk = _commit_eval_exps(m, threshold, gp.q)
+        for commits in all_commitments.values():
+            bases.extend(c % gp.p for c in commits)
+            exps.extend(jk)
+    pows = eng.pow_batch(bases, exps)
+    vks: List[int] = []
+    per_m = len(all_commitments) * threshold
+    for m in range(n):
+        prod = 1
+        for v in pows[m * per_m : (m + 1) * per_m]:
+            prod = prod * v % gp.p
+        vks.append(prod)
+    pub = ThresholdPublicKey(
+        n=n,
+        threshold=threshold,
+        master=master,
+        verification_keys=tuple(vks),
+        group=gp,
+    )
+    return pub, ThresholdSecretShare(index=my_index, value=x_j)
+
+
+def run_dkg(
+    n: int,
+    threshold: int,
+    group: GroupParams = DEFAULT_GROUP,
+    seed: Optional[int] = None,
+    backend: str = "cpu",
+    mesh=None,
+    corrupt_dealers: Sequence[int] = (),
+) -> Tuple[ThresholdPublicKey, List[ThresholdSecretShare], List[int]]:
+    """Drive the whole protocol in-process (the test/simulation
+    harness; a deployment pumps the same four steps over its own
+    private channels).  ``corrupt_dealers`` hand out a tampered share
+    to receiver 1 — the complaint flow must disqualify exactly them.
+
+    Returns (pub, shares, qualified_dealer_indices)."""
+    dealings = {
+        i: DkgDealing(i, n, threshold, group, seed=seed)
+        for i in range(1, n + 1)
+    }
+    commits = {
+        i: d.commitments(backend=backend, mesh=mesh)
+        for i, d in dealings.items()
+    }
+    # commitment subgroup validation first (see validate_commitments:
+    # skipping it lets a crafted broadcast split honest qualified sets)
+    commit_ok = validate_commitments(
+        [commits[i] for i in range(1, n + 1)],
+        group=group,
+        backend=backend,
+        mesh=mesh,
+    )
+    bad_commits = {
+        i for i, ok in zip(range(1, n + 1), commit_ok) if not ok
+    }
+    # every (dealer, receiver) share, tampered for corrupt dealers
+    shares: Dict[int, Dict[int, int]] = {}  # receiver -> dealer -> s
+    for j in range(1, n + 1):
+        shares[j] = {}
+        for i, d in dealings.items():
+            s = d.share_for(j)
+            if i in corrupt_dealers and j == 1:
+                s = (s + 1) % group.q
+            shares[j][i] = s
+    # batched verification of all n^2 shares; any failure = complaint
+    items = []
+    order = []
+    for j in range(1, n + 1):
+        for i in range(1, n + 1):
+            items.append((commits[i], j, shares[j][i]))
+            order.append((j, i))
+    verdicts = verify_dealer_shares(
+        items, group=group, backend=backend, mesh=mesh
+    )
+    disqualified = bad_commits | {
+        i for (j, i), ok in zip(order, verdicts) if not ok
+    }
+    qualified = sorted(set(range(1, n + 1)) - disqualified)
+    if len(qualified) < threshold:
+        raise RuntimeError(
+            f"only {len(qualified)} qualified dealers < t={threshold}"
+        )
+    q_commits = {i: commits[i] for i in qualified}
+    pub = None
+    out_shares: List[ThresholdSecretShare] = []
+    for j in range(1, n + 1):
+        p_j, sh_j = finalize(
+            q_commits,
+            j,
+            {i: shares[j][i] for i in qualified},
+            n,
+            threshold,
+            group=group,
+            backend=backend,
+            mesh=mesh,
+        )
+        if pub is None:
+            pub = p_j
+        else:
+            # agreement on the public state is a THEOREM here (pure
+            # function of broadcast commitments); assert it anyway
+            assert p_j == pub
+        out_shares.append(sh_j)
+    return pub, out_shares, qualified
+
+
+__all__ = [
+    "DkgDealing",
+    "verify_dealer_shares",
+    "finalize",
+    "run_dkg",
+]

@@ -1,0 +1,132 @@
+"""DKG (ops/dkg.py): threshold keys without the trusted dealer.
+
+The output must be a drop-in for ops.tpke.deal's (pub, shares): TPKE
+encrypt/decrypt, the common coin, and a full SimulatedCluster epoch
+all run on DKG-generated keys."""
+
+import pytest
+
+from cleisthenes_tpu.ops import dkg, tpke
+from cleisthenes_tpu.ops.coin import CommonCoin
+
+
+def test_dkg_keys_reconstruct_and_decrypt():
+    pub, shares, qualified = dkg.run_dkg(n=5, threshold=3, seed=7)
+    assert qualified == [1, 2, 3, 4, 5]
+    # verification keys really are g^{x_j}
+    gp = pub.group
+    for sh in shares:
+        assert pow(gp.g, sh.value, gp.p) == pub.verification_keys[sh.index - 1]
+    # TPKE end to end on the DKG key set
+    svc = tpke.Tpke(pub)
+    ct = svc.encrypt(b"no dealer was harmed in the making of this key")
+    dec = [svc.dec_share(sh, ct) for sh in shares[:3]]
+    assert all(svc.verify_dec_shares(ct, dec))
+    assert (
+        svc.combine(ct, dec)
+        == b"no dealer was harmed in the making of this key"
+    )
+    # subset independence: any t shares combine to the same plaintext
+    dec2 = [svc.dec_share(sh, ct) for sh in shares[2:]]
+    assert svc.combine(ct, dec2) == svc.combine(ct, dec)
+
+
+def test_dkg_coin_tosses_agree():
+    pub, shares, _ = dkg.run_dkg(n=4, threshold=2, seed=9)
+    coin = CommonCoin(pub)
+    cid = b"dkg-coin|0"
+    sh = [coin.share(s, cid) for s in shares]
+    assert all(coin.verify_shares(cid, sh))
+    t1 = coin.toss(cid, sh[:2])
+    t2 = coin.toss(cid, sh[2:])
+    assert t1 == t2  # any threshold subset yields the network bit
+
+
+def test_dkg_disqualifies_corrupt_dealer():
+    pub, shares, qualified = dkg.run_dkg(
+        n=5, threshold=3, seed=11, corrupt_dealers=[4]
+    )
+    assert qualified == [1, 2, 3, 5]
+    svc = tpke.Tpke(pub)
+    ct = svc.encrypt(b"qualified-set key still works")
+    dec = [svc.dec_share(sh, ct) for sh in shares[:3]]
+    assert svc.combine(ct, dec) == b"qualified-set key still works"
+
+
+def test_dkg_too_many_corrupt_dealers_fails_loudly():
+    with pytest.raises(RuntimeError):
+        dkg.run_dkg(n=3, threshold=3, seed=2, corrupt_dealers=[1])
+
+
+def test_dkg_share_verification_rejects_tampering():
+    d = dkg.DkgDealing(1, 4, 2, seed=5)
+    commits = d.commitments()
+    good = d.share_for(2)
+    ok = dkg.verify_dealer_shares(
+        [(commits, 2, good), (commits, 2, good + 1), (commits, 3, good)]
+    )
+    assert ok == [True, False, False]  # wrong value / wrong receiver
+
+
+def test_cluster_runs_on_dkg_keys():
+    """Full HBBFT epoch over the in-proc transport with every
+    threshold key DKG-generated (no dealer anywhere): setup_keys'
+    output shape rebuilt from run_dkg results."""
+    from cleisthenes_tpu.config import Config
+    from cleisthenes_tpu.protocol.cluster import SimulatedCluster
+    from cleisthenes_tpu.protocol.honeybadger import NodeKeys, setup_keys
+
+    n = 4
+    cfg = Config(n=n, batch_size=16)
+    tpke_pub, tpke_shares, _ = dkg.run_dkg(
+        n=n, threshold=cfg.decryption_threshold, seed=21
+    )
+    coin_pub, coin_shares, _ = dkg.run_dkg(
+        n=n, threshold=cfg.f + 1, seed=22
+    )
+    cluster = SimulatedCluster(n=n, batch_size=16, seed=3, key_seed=33)
+    ids = cluster.ids
+    dealer = setup_keys(cfg, ids, seed=33)  # only for the MAC keys
+    # swap the dealer keys for the DKG keys before any traffic
+    for i, nid in enumerate(ids):
+        hb = cluster.nodes[nid]
+        hb.keys = NodeKeys(
+            tpke_pub=tpke_pub,
+            tpke_share=tpke_shares[i],
+            coin_pub=coin_pub,
+            coin_share=coin_shares[i],
+            mac_keys=dealer[nid].mac_keys,
+        )
+        hb.tpke = hb.crypto.tpke(tpke_pub)
+        hb.coin = hb.crypto.coin(coin_pub)
+    for i in range(32):
+        cluster.submit(b"dkg-tx-%02d" % i)
+    cluster.run_epochs()
+    hist = {
+        tuple(tuple(sorted(b.tx_list())) for b in cluster.committed(nid))
+        for nid in ids
+    }
+    assert len(hist) == 1
+    assert sum(len(b) for b in cluster.committed()) == 32
+
+
+def test_non_subgroup_commitment_disqualifies_dealer():
+    """A commitment with an order-2 component must disqualify its
+    dealer deterministically BEFORE exponent arithmetic — otherwise
+    the mod-q-reduced verification equation evaluates inconsistently
+    across receivers and honest nodes' qualified sets diverge."""
+    from cleisthenes_tpu.ops.modmath import DEFAULT_GROUP
+
+    gp = DEFAULT_GROUP
+    d = dkg.DkgDealing(1, 4, 2, seed=5)
+    good = d.commitments()
+    # p-1 has order 2: not in the QR subgroup
+    assert dkg.validate_commitments([good, [good[0], gp.p - 1]]) == [
+        True,
+        False,
+    ]
+    # 0 and 1 are rejected too (identity/degenerate)
+    assert dkg.validate_commitments([[1, good[1]], [0, good[1]]]) == [
+        False,
+        False,
+    ]
