@@ -3,6 +3,8 @@ transport committing identical batches (BASELINE config 1), plus the
 batch-policy unit tests mirroring the reference's
 honeybadger_internal_test.go:8-180."""
 
+import os
+
 import pytest
 
 from cleisthenes_tpu.config import Config
@@ -352,3 +354,27 @@ def test_full_epoch_n64_agreement_and_validity():
     assert set(committed) <= set(txs)
     assert len(committed) == len(set(committed))
     assert set(committed) == set(txs)
+
+
+@pytest.mark.skipif(
+    os.environ.get("RUN_SLOW") != "1",
+    reason="~4 min: full-protocol N=128 epoch (RUN_SLOW=1 to enable)",
+)
+def test_n128_full_protocol_epoch():
+    """BASELINE config 4 on the REAL message-passing path: one
+    N=128/f=42 epoch over the in-proc transport — every frame through
+    the codec and MACs — commits with agreement on all 128 nodes.
+    (Measured ~130 s/epoch on one CPU core; the lockstep executor
+    covers this scale in the default bench, protocol_spmd_n128.)"""
+    from cleisthenes_tpu.protocol.cluster import SimulatedCluster
+
+    cluster = SimulatedCluster(n=128, batch_size=1024, seed=7, key_seed=5)
+    for i in range(1024):
+        cluster.submit(b"n128-tx-%06d" % i)
+    cluster.run_epochs(max_rounds=3)
+    hist = {
+        tuple(tuple(sorted(b.tx_list())) for b in cluster.committed(nid))
+        for nid in cluster.ids
+    }
+    assert len(hist) == 1
+    assert sum(len(b) for b in cluster.committed()) == 1024
