@@ -128,8 +128,9 @@ def validate_commitments(
     group: GroupParams = DEFAULT_GROUP,
     backend: str = "cpu",
     mesh=None,
+    threshold: Optional[int] = None,
 ) -> List[bool]:
-    """Subgroup membership for whole commitment vectors, batched.
+    """Shape + subgroup membership for whole commitment vectors.
 
     REQUIRED before any exponent arithmetic on a dealer's broadcast:
     the verification equation reduces exponents mod q, which is sound
@@ -139,7 +140,13 @@ def validate_commitments(
     differs per evaluation point), splitting honest nodes' qualified
     sets — an agreement break, not just a bad key.  Membership is a
     deterministic property of the broadcast bytes, so every honest
-    node disqualifies the same dealers."""
+    node disqualifies the same dealers.
+
+    ``threshold`` (when given) also pins the vector LENGTH: a wrong-
+    length broadcast must disqualify its dealer here, not crash every
+    honest verifier downstream (an empty vector is vacuously
+    "all-member", and a t' != t vector desynchronizes the flattened
+    exponent batches of verify/finalize)."""
     gp = group
     eng = get_engine(
         backend if gp.p.bit_length() <= 256 else "cpu", mesh, gp
@@ -153,7 +160,8 @@ def validate_commitments(
     out: List[bool] = []
     off = 0
     for (commits, span) in zip(commitment_sets, spans):
-        ok = all(
+        ok = span > 0 and (threshold is None or span == threshold)
+        ok = ok and all(
             1 < (c % gp.p) and pows[off + i] == 1
             for i, c in enumerate(commits)
         )
@@ -186,6 +194,9 @@ def verify_dealer_shares(
     spans: List[int] = []
     for commitments, j, share in items:
         t = len(commitments)
+        if t == 0:
+            spans.append(0)  # malformed broadcast: verdict False below
+            continue
         jk = _commit_eval_exps(j, t, gp.q)
         bases.extend(c % gp.p for c in commitments)
         exps.extend(jk)
@@ -196,6 +207,9 @@ def verify_dealer_shares(
     out: List[bool] = []
     off = 0
     for span in spans:
+        if span == 0:
+            out.append(False)
+            continue
         prod = 1
         for v in pows[off : off + span - 1]:
             prod = prod * v % gp.p
@@ -226,6 +240,14 @@ def finalize(
         raise ValueError("commitment/share dealer sets differ")
     if not all_commitments:
         raise ValueError("empty qualified set")
+    for i, commits in all_commitments.items():
+        if len(commits) != threshold:
+            # qualified dealers were length-validated; a mismatch here
+            # is a caller bug and must fail loudly, not desync the
+            # flattened exponent batches below
+            raise ValueError(
+                f"dealer {i}: {len(commits)} commitments != t={threshold}"
+            )
     gp = group
     eng = get_engine(
         backend if gp.p.bit_length() <= 256 else "cpu", mesh, gp
@@ -291,6 +313,7 @@ def run_dkg(
         group=group,
         backend=backend,
         mesh=mesh,
+        threshold=threshold,
     )
     bad_commits = {
         i for i, ok in zip(range(1, n + 1), commit_ok) if not ok

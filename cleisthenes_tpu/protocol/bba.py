@@ -429,8 +429,18 @@ class BBA:
 
     def _on_coin_verdicts(self, rnd: int, senders, ok) -> None:
         r = self._rounds.get(rnd)
-        if r is not None:
-            r.coin_shares.apply_verdicts(senders, ok)
+        if r is None:
+            return
+        r.coin_shares.apply_verdicts(senders, ok)
+        if not all(ok) and r.coin_shares.need_more():
+            # an invalid share burned a collected slot: the surplus
+            # shares already PARKED in the pool are the replacements,
+            # and under dirty-set flushing nothing else would re-offer
+            # them (no new arrival is coming — every share may already
+            # be here).  Re-mark so the flush loop's next collection
+            # round pulls them; without this the coin stays unrevealed
+            # forever (liveness break found by round-3 review).
+            self.hub.mark_dirty(self)
 
     def after_crypto_flush(self) -> None:
         if self.halted:

@@ -745,11 +745,19 @@ class HoneyBadger:
                         self.tpke.context(ct),
                         senders,
                         shs,
-                        lambda snd, ok, pool=pool: pool.apply_verdicts(
-                            snd, ok
+                        lambda snd, ok, pool=pool: self._on_dec_verdicts(
+                            pool, snd, ok
                         ),
                     )
                 )
+
+    def _on_dec_verdicts(self, pool, senders, ok) -> None:
+        pool.apply_verdicts(senders, ok)
+        if not all(ok) and pool.need_more():
+            # burned slot, replacements already parked: re-mark or the
+            # dirty-set flush never collects them again (same liveness
+            # hazard as BBA._on_coin_verdicts; round-3 review)
+            self.hub.mark_dirty(self)
 
     def after_crypto_flush(self) -> None:
         for epoch, es in list(self._epochs.items()):

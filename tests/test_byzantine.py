@@ -185,3 +185,54 @@ def test_byzantine_invalid_dec_share_falls_back_to_verified_path():
         for pool in es.dec_shares.values()
     )
     assert fallbacks + burned > 0  # junk was seen and survived
+
+
+def test_byzantine_invalid_coin_share_does_not_stall_reveal():
+    """Regression (round-3 review): a Byzantine member broadcasting
+    invalid coin shares burns its collected slot, and the REPLACEMENT
+    shares already parked in the pool must still be collected — under
+    dirty-set flushing nothing else re-offers them (every share may
+    already have arrived), so the verdict callback re-marks the BBA.
+    Pre-fix, every node's round-0 coin stayed unrevealed forever and
+    zero transactions committed."""
+    from cleisthenes_tpu.ops import tpke as tpke_mod
+
+    cfg, net, nodes = make_hb_network(4, batch_size=8)  # FIFO scheduler
+    bad = "node0"  # sorts first: collected into the f+1 verify subset
+    hb_bad = nodes[bad]
+    real_issue = tpke_mod.issue_share
+    bad_secret_value = hb_bad.keys.coin_share.value
+
+    def junk_issue(share, base, context, group=tpke_mod.DEFAULT_GROUP):
+        good = real_issue(share, base, context, group)
+        if (
+            context.startswith(b"coin|")
+            and share.value == bad_secret_value
+        ):
+            return tpke_mod.DhShare(
+                index=good.index, d=12345, e=good.e, z=good.z
+            )
+        return good
+
+    tpke_mod.issue_share = junk_issue
+    try:
+        # route the patched module function through the bad node's coin
+        hb_bad.coin.share = (
+            lambda secret, coin_id: junk_issue(
+                secret,
+                __import__(
+                    "cleisthenes_tpu.ops.coin", fromlist=["coin_base"]
+                ).coin_base(coin_id, hb_bad.coin.group),
+                b"coin|" + coin_id,
+                hb_bad.coin.group,
+            )
+        )
+        push_txs(nodes, 12)
+        run_epochs(net, nodes)
+    finally:
+        tpke_mod.issue_share = real_issue
+    assert_identical_batches(nodes)
+    committed = sum(
+        len(b) for b in nodes["node1"].committed_batches
+    )
+    assert committed == 12  # liveness: everything still commits

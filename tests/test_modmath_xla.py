@@ -70,3 +70,26 @@ def test_issue_and_combine_batch_match_scalar():
     assert a == b  # subset independence
     got = T.combine_shares_batch([out[:2], out[1:3], out[2:]], 2)
     assert got == [a, a, a]
+
+
+def test_pow_batch_grouped_device_path_with_splits_and_tails():
+    """The comb kernel's full engine path — G_ROW splitting, per-size
+    compile buckets, and strictly-ordered reassembly of a group whose
+    tail slice lands in a different bucket — above the device
+    crossover (every other suite runs backend='cpu' and would take the
+    flat fallback, leaving this logic untested)."""
+    eng = mm.ModEngine("tpu", group=mm.DEFAULT_GROUP)
+    rnd = random.Random(11)
+    p, q = mm.DEFAULT_GROUP.p, mm.DEFAULT_GROUP.q
+    groups = [
+        (rnd.randrange(2, p), [rnd.randrange(0, q) for _ in range(sz)])
+        # 700/1200 force G_ROW=512 splits with odd tails; 3 keeps a
+        # tiny group in the same dispatch plan; total 2003 >= crossover
+        for sz in (700, 1200, 100, 3)
+    ]
+    out = eng.pow_batch_grouped(groups)
+    for (base, exps), res in zip(groups, out):
+        assert len(res) == len(exps)
+        for i in range(0, len(exps), 97):
+            assert res[i] == pow(base, exps[i], p)
+        assert res[-1] == pow(base, exps[-1], p)  # tail ordering

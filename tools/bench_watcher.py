@@ -59,23 +59,24 @@ def capture() -> bool:
                 timeout=BENCH_TIMEOUT_S,
                 cwd=REPO,
             )
-    except subprocess.TimeoutExpired:
-        os.unlink(tmp)
-        return False
-    if r.returncode != 0:
-        os.unlink(tmp)
-        return False
-    try:
+        if r.returncode != 0:
+            return False
         with open(tmp) as f:
             doc = json.loads(f.readline())
-    except (json.JSONDecodeError, OSError):
-        os.unlink(tmp)
+        if doc.get("platform") != "tpu":
+            return False  # fallback run: never clobber TPU evidence
+        os.replace(tmp, ARTIFACT)
+        return True
+    except (subprocess.TimeoutExpired, json.JSONDecodeError, OSError):
         return False
-    if doc.get("platform") != "tpu":
-        os.unlink(tmp)  # fallback run: never clobber TPU evidence
-        return False
-    os.replace(tmp, ARTIFACT)
-    return True
+    finally:
+        # every non-replace exit (timeout, bad rc, fallback, crash,
+        # KeyboardInterrupt) must clean the tempfile up
+        if os.path.exists(tmp):
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
 
 
 def main() -> None:
