@@ -41,6 +41,20 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--verbose", action="store_true", help="debug-level node logs"
     )
+    ap.add_argument(
+        "--mode",
+        default="grpc",
+        choices=["grpc", "lockstep"],
+        help="grpc: N real validator processes-in-threads over "
+        "localhost sockets; lockstep: the batched SPMD executor "
+        "(protocol.spmd) — the mode for big-N capacity runs",
+    )
+    ap.add_argument(
+        "--dkg",
+        action="store_true",
+        help="generate threshold keys by distributed key generation "
+        "(ops.dkg) instead of the trusted dealer",
+    )
     args = ap.parse_args(argv)
     configure_logging(logging.DEBUG if args.verbose else logging.INFO)
 
@@ -50,9 +64,14 @@ def main(argv=None) -> int:
     ids = [f"node{i}" for i in range(args.n)]
     print(
         f"== cleisthenes-tpu demo: n={args.n} f={cfg.f} "
-        f"batch={args.batch_size} crypto={args.crypto}"
+        f"batch={args.batch_size} crypto={args.crypto} mode={args.mode}"
+        + (" keys=dkg" if args.dkg else " keys=dealer")
     )
+    if args.mode == "lockstep":
+        return _lockstep_main(args, cfg)
     keys = setup_keys(cfg, ids)
+    if args.dkg:
+        keys = _dkg_rekey(cfg, ids, keys)
     if args.log_dir:
         os.makedirs(args.log_dir, exist_ok=True)
     hosts = {
@@ -112,6 +131,63 @@ def main(argv=None) -> int:
         h.stop()
     ok = committed == set(txs)
     print(f"== {'SUCCESS' if ok else 'TIMEOUT'}: {len(committed)}/{len(txs)} txs committed")
+    return 0 if ok else 1
+
+
+def _dkg_rekey(cfg: Config, ids, dealer_keys):
+    """Replace the dealer's threshold keys with DKG-generated ones
+    (pairwise MAC keys keep the dealer — they are symmetric transport
+    secrets, not threshold material; see ops/dkg.py on carriage)."""
+    from cleisthenes_tpu.ops import dkg
+    from cleisthenes_tpu.protocol.honeybadger import NodeKeys
+
+    tpke_pub, tpke_shares, q1 = dkg.run_dkg(
+        n=cfg.n, threshold=cfg.decryption_threshold
+    )
+    coin_pub, coin_shares, q2 = dkg.run_dkg(n=cfg.n, threshold=cfg.f + 1)
+    print(
+        f"== DKG complete: {len(q1)}/{cfg.n} qualified dealers (tpke), "
+        f"{len(q2)}/{cfg.n} (coin); no trusted dealer"
+    )
+    return {
+        nid: NodeKeys(
+            tpke_pub=tpke_pub,
+            tpke_share=tpke_shares[i],
+            coin_pub=coin_pub,
+            coin_share=coin_shares[i],
+            mac_keys=dealer_keys[nid].mac_keys,
+        )
+        for i, nid in enumerate(sorted(ids))
+    }
+
+
+def _lockstep_main(args, cfg: Config) -> int:
+    """--mode lockstep: the SPMD executor end to end."""
+    from cleisthenes_tpu.protocol.spmd import LockstepCluster
+
+    cluster = LockstepCluster(config=cfg)
+    prefix = b"demo-%d" % time.time_ns()
+    txs = [b"%s-tx-%05d" % (prefix, i) for i in range(args.txs)]
+    for tx in txs:
+        cluster.submit(tx)
+    t0 = time.monotonic()
+    epochs = cluster.run_epochs()
+    wall = time.monotonic() - t0
+    committed = set()
+    for batch in cluster.committed():
+        committed |= set(batch.tx_list()) & set(txs)
+    s = cluster.last_stats
+    print(
+        f"== {epochs} lockstep epoch(s) in {wall:.2f}s; last epoch: "
+        + " ".join(
+            f"{k}={v:.3f}s" for k, v in s.items() if k.endswith("_s")
+        )
+    )
+    ok = committed == set(txs)
+    print(
+        f"== {'SUCCESS' if ok else 'INCOMPLETE'}: "
+        f"{len(committed)}/{len(txs)} txs committed"
+    )
     return 0 if ok else 1
 
 
