@@ -1,5 +1,7 @@
 """SimulatedCluster API + shared-hub regression tests."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -61,3 +63,31 @@ def test_shared_hub_epoch_gc_is_node_scoped():
     # scope is node-qualified (node_id, epoch-or-tag)
     for scope in hub._clients:
         assert isinstance(scope, tuple) and scope[0] in c.nodes
+
+
+@pytest.mark.skipif(
+    os.environ.get("RUN_SLOW") != "1",
+    reason="~3 min memory soak (RUN_SLOW=1 to enable)",
+)
+def test_cluster_memory_soak():
+    """30 epochs over one cluster: RSS must plateau after warm-up —
+    the dedup memos, payload memo, parked-message buffers, and epoch
+    GC are all bounded (caps + drop_scope eviction)."""
+    import gc
+    import resource
+
+    c = SimulatedCluster(n=8, batch_size=64, seed=4)
+
+    def rss_mb():
+        return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss // 1024
+
+    base = None
+    for burst in range(6):
+        for i in range(64 * 5):
+            c.submit(b"soak-%d-%05d" % (burst, i))
+        c.run_epochs()
+        gc.collect()
+        if burst == 1:
+            base = rss_mb()
+    assert sum(len(b) for b in c.committed()) == 64 * 5 * 6
+    assert rss_mb() - base < 120, "unbounded growth across epochs"
