@@ -73,7 +73,7 @@ def gf_pow(a: int, n: int) -> int:
         return 1
     if a == 0:
         return 0
-    return int(GF_EXP[(GF_LOG[a] * n) % 255])
+    return int(GF_EXP[(int(GF_LOG[a]) * n) % 255])
 
 
 # ---------------------------------------------------------------------------

@@ -69,7 +69,9 @@ def gf_pow(a: int, n: int) -> int:
         return 1
     if a == 0:
         return 0
-    return int(GF_EXP[(GF_LOG[a] * n) % (ORDER - 1)])
+    # Multiply in Python ints: GF_LOG is int32 and GF_LOG[a] * n wraps
+    # silently for n >~ 32768 at this field's index scale.
+    return int(GF_EXP[(int(GF_LOG[a]) * n) % (ORDER - 1)])
 
 
 def gf_mul_vec(a: np.ndarray, b: np.ndarray) -> np.ndarray:

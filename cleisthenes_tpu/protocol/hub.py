@@ -167,6 +167,10 @@ class CryptoHub:
             self._share_memo.map.clear()
             self._branch_memo.map.clear()
             self._decode_memo.map.clear()
+            # the memos keyed by these tokens are gone, so a held key
+            # object has no remaining value — dropping the table stops
+            # unbounded growth under epoch re-keying
+            self._pub_tokens.clear()
 
     # -- flushing ----------------------------------------------------------
 
