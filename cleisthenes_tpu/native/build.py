@@ -92,6 +92,26 @@ def _configure_modpow(lib: ctypes.CDLL) -> None:
         raise RuntimeError(f"modpow256 selftest failed: {rc}")
 
 
+def _configure_sha256(lib: ctypes.CDLL) -> None:
+    lib.sha256_rows.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_void_p, ctypes.c_void_p,
+    ]
+    lib.sha256_rows_fixed.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_int64, ctypes.c_void_p,
+    ]
+    lib.sha256_selftest.restype = ctypes.c_int
+    rc = lib.sha256_selftest()
+    if rc != 0:
+        raise RuntimeError(f"sha256rows selftest failed: {rc}")
+
+
+def load_sha256() -> Optional[ctypes.CDLL]:
+    """The batched SHA-256 library, or None (no toolchain)."""
+    return _load("sha256rows", _configure_sha256)
+
+
 def load_gf256() -> Optional[ctypes.CDLL]:
     """The GF(2^8) RS kernel library, or None (no toolchain)."""
     return _load("gf256", _configure_gf256)
@@ -106,4 +126,4 @@ def native_available() -> bool:
     return load_gf256() is not None
 
 
-__all__ = ["load_gf256", "load_modpow", "native_available"]
+__all__ = ["load_gf256", "load_modpow", "load_sha256", "native_available"]

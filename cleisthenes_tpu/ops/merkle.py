@@ -163,17 +163,13 @@ class MerkleBackend(abc.ABC):
 
 
 class CpuMerkle(MerkleBackend):
-    """hashlib reference backend."""
+    """Host backend: one native batched-SHA crossing per level
+    (ops/hashrows; identical digests to the old hashlib loop)."""
 
     def _hash_batch(self, msgs: np.ndarray) -> np.ndarray:
-        return np.stack(
-            [
-                np.frombuffer(
-                    hashlib.sha256(m.tobytes()).digest(), dtype=np.uint8
-                )
-                for m in msgs
-            ]
-        )
+        from cleisthenes_tpu.ops.hashrows import sha256_rows
+
+        return sha256_rows(msgs)
 
 
 class XlaMerkle(MerkleBackend):

@@ -36,6 +36,8 @@ import hmac
 import secrets
 from typing import Dict, List, Optional, Sequence, Tuple
 
+import numpy as np
+
 from cleisthenes_tpu.ops.modmath import (
     DEFAULT_GROUP,
     G,
@@ -58,6 +60,61 @@ def _hash_to_int(*parts: bytes) -> int:
         )
     )
     return int.from_bytes(h.digest(), "big")
+
+
+def _cp_challenge_batch(
+    contexts: Sequence[bytes],
+    bases: Sequence[int],
+    his: Sequence[int],
+    ds: Sequence[int],
+    a1s: Sequence[int],
+    a2s: Sequence[int],
+    group: "GroupParams",
+) -> List[int]:
+    """All of a wave's CP challenges e = H(cp transcript) mod q in one
+    batched native hash — byte-identical to mapping ``_hash_to_int``
+    over the items (tests assert the equivalence), but the transcript
+    rows are assembled as numpy columns and digested in a single
+    ctypes crossing instead of ~m Python hash calls.
+
+    Rows are grouped by context length (field offsets are constant
+    within a group); a lockstep wave has a handful of context shapes,
+    so this stays a couple of matrix fills."""
+    from cleisthenes_tpu.ops.hashrows import ints_to_be_rows, sha256_rows
+
+    m = len(contexts)
+    if m == 0:
+        return []
+    nb, q = group.nbytes, group.q
+    cols = [
+        ints_to_be_rows(vals, nb)
+        for vals in (bases, his, ds, a1s, a2s)
+    ]
+    head_pfx = (2).to_bytes(4, "big") + b"cp"
+    heads = [
+        head_pfx + len(c).to_bytes(4, "big") + c for c in contexts
+    ]
+    by_hl: Dict[int, List[int]] = {}
+    for i, h in enumerate(heads):
+        by_hl.setdefault(len(h), []).append(i)
+    field_pfx = np.frombuffer(nb.to_bytes(4, "big"), dtype=np.uint8)
+    out: List[int] = [0] * m
+    for hl, idxs in by_hl.items():
+        k = len(idxs)
+        rows = np.empty((k, hl + 5 * (4 + nb)), dtype=np.uint8)
+        rows[:, :hl] = np.frombuffer(
+            b"".join(heads[i] for i in idxs), dtype=np.uint8
+        ).reshape(k, hl)
+        off = hl
+        sel = np.asarray(idxs, dtype=np.intp)
+        for col in cols:
+            rows[:, off : off + 4] = field_pfx
+            rows[:, off + 4 : off + 4 + nb] = col[sel]
+            off += 4 + nb
+        digs = sha256_rows(rows)
+        for row, i in zip(digs, idxs):
+            out[i] = int.from_bytes(row.tobytes(), "big") % q
+    return out
 
 
 def _ibytes(x: int, nbytes: int = 32) -> bytes:
@@ -286,29 +343,41 @@ def issue_shares_batch(
     g_res = pows[0]
     base_res = {b: res for b, res in zip(base_order, pows[1:])}
     base_off = {b: 0 for b in base_order}
-    out: List[DhShare] = []
     g_off = 0
-    for (share, base, context, vk), w in zip(items, ws):
-        a1 = g_res[g_off]
+    a1s: List[int] = []
+    his: List[int] = []
+    a2s: List[int] = []
+    ds: List[int] = []
+    for share, base, _context, vk in items:
+        a1s.append(g_res[g_off])
         g_off += 1
         if vk is None:
-            hi = g_res[g_off]
+            his.append(g_res[g_off])
             g_off += 1
         else:
-            hi = vk
+            his.append(vk)
         bo = base_off[base]
-        a2, d = base_res[base][bo], base_res[base][bo + 1]
+        a2s.append(base_res[base][bo])
+        ds.append(base_res[base][bo + 1])
         base_off[base] = bo + 2
-        e = (
-            _hash_to_int(
-                b"cp", context, _ibytes(base, nbytes), _ibytes(hi, nbytes),
-                _ibytes(d, nbytes), _ibytes(a1, nbytes), _ibytes(a2, nbytes),
-            )
-            % q
+    es = _cp_challenge_batch(
+        [it[2] for it in items],
+        [it[1] for it in items],
+        his,
+        ds,
+        a1s,
+        a2s,
+        group,
+    )
+    return [
+        DhShare(
+            index=share.index,
+            d=d,
+            e=e,
+            z=(w + e * share.value) % q,
         )
-        z = (w + e * share.value) % q
-        out.append(DhShare(index=share.index, d=d, e=e, z=z))
-    return out
+        for (share, _b, _c, _vk), w, d, e in zip(items, ws, ds, es)
+    ]
 
 
 def combine_shares_batch(
@@ -402,33 +471,14 @@ def verify_share_groups(
         # decomposition saves fewer multiplies than it spends on extra
         # dispatches and host marshalling.
         a = _verify_pows_dual(gp, eng, groups, idx_list)
-        off = 0
-        nb = gp.nbytes
-        for gi in idx_list:
-            pub, base, shares, context = groups[gi]
-            res = []
-            for sh in shares:
-                a1, a2 = a[off], a[off + 1]
-                off += 2
-                if not (1 <= sh.index <= pub.n) or not (0 < sh.d < gp.p):
-                    res.append(False)
-                    continue
-                hi = pub.verification_keys[sh.index - 1]
-                e_want = (
-                    _hash_to_int(
-                        b"cp", context, _ibytes(base, nb), _ibytes(hi, nb),
-                        _ibytes(sh.d, nb), _ibytes(a1, nb), _ibytes(a2, nb),
-                    )
-                    % gp.q
-                )
-                res.append(e_want == sh.e % gp.q)
-            results[gi] = res
+        results.update(_cp_verdicts(gp, groups, idx_list, a))
     return [results[gi] for gi in range(len(groups))]
 
 
-def _verify_pows_dual(gp, eng, groups, idx_list) -> List[int]:
-    """(A1, A2) per share via the fused dual-exponentiation kernel —
-    the host path and the small-batch device path."""
+def _verify_dual_items(gp, groups, idx_list):
+    """The (u1, e1, u2, e2) dual-exponentiation lists recomputing
+    (A1, A2) for every share of ``idx_list``'s groups — shared by the
+    plain and the fused verifiers so the two can never drift."""
     u1, e1, u2, e2 = [], [], [], []
     for gi in idx_list:
         pub, base, shares, _context = groups[gi]
@@ -446,7 +496,177 @@ def _verify_pows_dual(gp, eng, groups, idx_list) -> List[int]:
             # A2 = base^z * d^{-e}
             u1.append(base); e1.append(sh.z % gp.q)
             u2.append(sh.d % gp.p); e2.append(neg_e)
+    return u1, e1, u2, e2
+
+
+def _cp_verdicts(gp, groups, idx_list, a) -> Dict[int, List[bool]]:
+    """Verdicts from the recomputed (A1, A2) stream ``a`` (two entries
+    per share, idx_list order): assemble every transcript, run ONE
+    batched challenge hash, compare — shared by the plain and fused
+    verifiers."""
+    off = 0
+    ctxs: List[bytes] = []
+    basel: List[int] = []
+    hil: List[int] = []
+    dl: List[int] = []
+    a1l: List[int] = []
+    a2l: List[int] = []
+    struct_ok: List[bool] = []
+    want_e: List[int] = []
+    for gi in idx_list:
+        pub, base, shares, context = groups[gi]
+        for sh in shares:
+            a1, a2 = a[off], a[off + 1]
+            off += 2
+            ok = (1 <= sh.index <= pub.n) and (0 < sh.d < gp.p)
+            hi = pub.verification_keys[sh.index - 1] if ok else 1
+            ctxs.append(context)
+            basel.append(base)
+            hil.append(hi)
+            dl.append(sh.d % gp.p)
+            a1l.append(a1)
+            a2l.append(a2)
+            struct_ok.append(ok)
+            want_e.append(sh.e % gp.q)
+    es = _cp_challenge_batch(ctxs, basel, hil, dl, a1l, a2l, gp)
+    results: Dict[int, List[bool]] = {}
+    k = 0
+    for gi in idx_list:
+        _pub, _base, shares, _context = groups[gi]
+        res = []
+        for _sh in shares:
+            res.append(struct_ok[k] and es[k] == want_e[k])
+            k += 1
+        results[gi] = res
+    return results
+
+
+def _verify_pows_dual(gp, eng, groups, idx_list) -> List[int]:
+    """(A1, A2) per share via the fused dual-exponentiation kernel —
+    the host path and the small-batch device path."""
+    u1, e1, u2, e2 = _verify_dual_items(gp, groups, idx_list)
     return eng.dual_pow_batch(u1, e1, u2, e2)
+
+
+def verify_and_combine_share_groups(
+    groups: Sequence[tuple],
+    threshold: int,
+    backend: str = "cpu",
+    mesh=None,
+    combine_only_sets: Sequence[Sequence[DhShare]] = (),
+    combine_only_group: Optional[GroupParams] = None,
+) -> Tuple[List[List[bool]], List[Optional[int]], List[int]]:
+    """Verify every group's CP proofs AND Lagrange-combine each group's
+    first ``threshold`` shares in ONE fused dual-exponentiation
+    dispatch (half the device round-trips of verify + combine run
+    separately — the lockstep BBA's per-round critical path).
+
+    ``groups`` is ``(pub, base, shares, context)`` as in
+    ``verify_share_groups``; returns ``(verdicts, values)`` where
+    ``values[i]`` is the combination of group i's shares (``None``
+    when the group has fewer than ``threshold`` shares).  Combination
+    does not wait for the verdicts — callers must discard the value
+    of any group whose verdicts fail (the lockstep executor asserts
+    them; the live path uses the unfused ops).  Results seed the
+    combine memo, so a later ``combine_shares`` on the same subset is
+    a pure host hit.
+
+    ``combine_only_sets`` are additional share sets (same threshold,
+    group ``combine_only_group`` — defaults to the first group's) to
+    Lagrange-combine WITHOUT verification in the same dispatch: the
+    lockstep executor rides its whole optimistic-decrypt wave on BBA
+    round 0's device round-trip this way.  Their values are the third
+    returned list."""
+    if not groups and not combine_only_sets:
+        return [], [], []
+    by_gp: Dict[GroupParams, List[int]] = {}
+    for gi, (pub, _base, _shares, _context) in enumerate(groups):
+        by_gp.setdefault(pub.group, []).append(gi)
+    co_gp: Optional[GroupParams] = None
+    if combine_only_sets:
+        co_gp = combine_only_group or (
+            groups[0][0].group if groups else DEFAULT_GROUP
+        )
+        by_gp.setdefault(co_gp, [])
+    verdicts: Dict[int, List[bool]] = {}
+    values: Dict[int, Optional[int]] = {}
+    co_values: List[int] = [0] * len(combine_only_sets)
+    for gp, idx_list in by_gp.items():
+        eng = get_engine(
+            backend if gp.p.bit_length() <= 256 else "cpu", mesh, gp
+        )
+        # verification duals first (2 per share), then combine terms
+        # (threshold per group) ride the same dispatch as u2^0 = 1
+        # dummy-factor duals
+        u1, e1, u2, e2 = _verify_dual_items(gp, groups, idx_list)
+        n_dual = len(u1)
+        comb_spans: List[tuple] = []  # (gi, memo_key, n_terms)
+        for gi in idx_list:
+            pub, _base, shares, _context = groups[gi]
+            if len(shares) < threshold:
+                values[gi] = None
+                continue
+            use = sorted(shares, key=lambda s: s.index)[:threshold]
+            xs = [s.index for s in use]
+            if len(set(xs)) != len(xs):
+                raise ValueError("duplicate share indices")
+            key = (gp, threshold, tuple((s.index, s.d) for s in use))
+            hit = _COMBINE_MEMO.get(key)
+            if hit is not None:
+                values[gi] = hit
+                continue
+            lams = lagrange_coeff_at_zero(xs, gp.q)
+            for sh, lam in zip(use, lams):
+                u1.append(sh.d % gp.p); e1.append(lam)
+                u2.append(1); e2.append(0)
+            comb_spans.append((gi, key, threshold))
+        co_spans: List[tuple] = []  # (set_idx, memo_key)
+        if gp == co_gp:  # equality, not identity: by_gp keys by value
+            for ci, shares in enumerate(combine_only_sets):
+                if len(shares) < threshold:
+                    raise ValueError(
+                        f"need >= {threshold} shares, got {len(shares)}"
+                    )
+                use = sorted(shares, key=lambda s: s.index)[:threshold]
+                xs = [s.index for s in use]
+                if len(set(xs)) != len(xs):
+                    raise ValueError("duplicate share indices")
+                key = (gp, threshold, tuple((s.index, s.d) for s in use))
+                hit = _COMBINE_MEMO.get(key)
+                if hit is not None:
+                    co_values[ci] = hit
+                    continue
+                lams = lagrange_coeff_at_zero(xs, gp.q)
+                for sh, lam in zip(use, lams):
+                    u1.append(sh.d % gp.p); e1.append(lam)
+                    u2.append(1); e2.append(0)
+                co_spans.append((ci, key))
+        a = eng.dual_pow_batch(u1, e1, u2, e2)
+        verdicts.update(_cp_verdicts(gp, groups, idx_list, a))
+        off = n_dual
+        for gi, key, n_terms in comb_spans:
+            acc = 1
+            for term in a[off : off + n_terms]:
+                acc = acc * term % gp.p
+            off += n_terms
+            if len(_COMBINE_MEMO) >= _COMBINE_MEMO_CAP:
+                _COMBINE_MEMO.clear()
+            _COMBINE_MEMO[key] = acc
+            values[gi] = acc
+        for ci, key in co_spans:
+            acc = 1
+            for term in a[off : off + threshold]:
+                acc = acc * term % gp.p
+            off += threshold
+            if len(_COMBINE_MEMO) >= _COMBINE_MEMO_CAP:
+                _COMBINE_MEMO.clear()
+            _COMBINE_MEMO[key] = acc
+            co_values[ci] = acc
+    return (
+        [verdicts[gi] for gi in range(len(groups))],
+        [values[gi] for gi in range(len(groups))],
+        co_values,
+    )
 
 
 def verify_shares(
@@ -764,6 +984,7 @@ __all__ = [
     "issue_shares_batch",
     "verify_shares",
     "verify_share_groups",
+    "verify_and_combine_share_groups",
     "combine_shares",
     "combine_shares_batch",
     "lagrange_coeff_at_zero",

@@ -59,7 +59,7 @@ from cleisthenes_tpu.ops.payload import join_payload, split_payload
 from cleisthenes_tpu.ops.tpke import (
     combine_shares_batch,
     issue_shares_batch,
-    verify_share_groups,
+    verify_and_combine_share_groups,
 )
 from cleisthenes_tpu.protocol.honeybadger import (
     deserialize_ciphertext,
@@ -72,15 +72,6 @@ from cleisthenes_tpu.protocol.honeybadger import (
 # A round decides with probability 1/2 per instance; 64 rounds is
 # P ~ 2^-64 per instance — the same class of bound as bba.MAX_ROUNDS.
 MAX_COIN_ROUNDS = 64
-# Coin rounds precomputed speculatively in one batched block (see the
-# BBA section of run_epoch): covers 1 - 2^-SPEC_ROUNDS of instances.
-# Measured on the axon relay, speculation past round 0 LOSES: it
-# doubles the exponentiation mass and promotes the tail rounds'
-# verify/combine batches off the native host floor, costing more than
-# the saved round-trips (3.0 s -> 3.8 s per N=128 epoch at 4).  On a
-# locally-attached chip with sub-ms dispatch the trade flips; the knob
-# stays for that deployment shape.
-SPEC_ROUNDS = 1
 
 
 class LockstepCluster:
@@ -192,13 +183,13 @@ class LockstepCluster:
         leaves = np.ascontiguousarray(full.reshape(n * n, L))
         depth = trees[0].depth
         branches = np.zeros((n * n, depth, 32), dtype=np.uint8)
+        leaf_idx = np.arange(n)
         for i, tree in enumerate(trees):
-            for j in range(n):
-                br = tree.branch(j)
-                for d_, sib in enumerate(br):
-                    branches[i * n + j, d_] = np.frombuffer(
-                        sib, dtype=np.uint8
-                    )
+            for d_ in range(depth):
+                # sibling of leaf j at depth d_ is level[d_][(j>>d_)^1]
+                branches[i * n : (i + 1) * n, d_] = tree.levels[d_][
+                    (leaf_idx >> d_) ^ 1
+                ]
         indices = np.tile(np.arange(n), n)
         ok = self.crypto.merkle.verify_batch(
             root_arr, leaves, branches, indices
@@ -225,16 +216,21 @@ class LockstepCluster:
         # vals == {1} each round, so the instance decides when its real
         # threshold coin tosses 1 (docs/BBA-EN.md:163-181).
         #
-        # Rounds 0..SPEC_ROUNDS-1 run SPECULATIVELY in one issue + one
-        # verify + one combine dispatch: a round-r coin share is a
-        # deterministic VUF of (epoch, proposer, r), independent of any
-        # protocol state, so a node may precompute shares for rounds it
-        # might never reach — trading a bounded amount of wasted
-        # exponentiation (expected 2x of the minimum; every instance's
-        # round count is geometric) for an 8x cut in sequential device
-        # round-trips.  Stragglers past the window fall back to the
-        # per-round path (tiny batches, host-floored to the native
-        # kernel).
+        # Rounds run in DOUBLING BLOCKS — [0], [1], [2,3], [4..7], … —
+        # each block one issue dispatch + one fused verify/combine
+        # dispatch for every (instance, round) pair in it.  A round-r
+        # coin share is a deterministic VUF of (epoch, proposer, r),
+        # independent of any protocol state, so precomputing a block
+        # for instances that may decide mid-block only wastes a
+        # BOUNDED slice of issue mass (~N^2/4 expected, ~12% over the
+        # sequential minimum — the undecided set halves each round
+        # while block sizes double), and the number of sequential
+        # device waves falls from E[max rounds] ~ log2 N + 2 to
+        # O(log log-rounds): 7 rounds of N=128 take 4 waves x 2
+        # dispatches instead of 7 x 3.  (The round-3 flat-speculation
+        # knob lost on the relay because it issued EVERY round for
+        # EVERY instance; the doubling schedule keeps the waste
+        # proportional to the tail, not the roster.)
         t0 = time.perf_counter()
         coin_pub = self.coin.pub
         coin_vks = coin_pub.verification_keys
@@ -244,9 +240,33 @@ class LockstepCluster:
         undecided = list(range(n))
         coin_bits: Dict[tuple, bool] = {}  # (inst, rnd) -> toss
 
-        def run_rounds(rnd_list, inst_list):
-            """Issue + verify + combine + toss for every (inst, rnd)
-            pair, three dispatches total; fills coin_bits."""
+        # the decrypt wave (N^2 share issues + N optimistic combines)
+        # depends only on the RBC-delivered ciphertexts, never on the
+        # coin — so its issue items ride BBA round 0's issue dispatch
+        # and its combines ride round 0's fused verify/combine
+        # dispatch: the whole wave costs ZERO extra device round-trips
+        tpke_pub = self.tpke.pub
+        tpke_vks = tpke_pub.verification_keys
+        cts = [deserialize_ciphertext(v, group) for v in delivered]
+        dec_items = []
+        for ct in cts:
+            context = self.tpke.context(ct)
+            for nid in ids:
+                sec = self.keys[nid].tpke_share
+                dec_items.append(
+                    (sec, ct.c1, context, tpke_vks[sec.index - 1])
+                )
+        # riding round 0 requires one shared Lagrange threshold;
+        # distinct thresholds (non-default configs) fall back to a
+        # separate decrypt wave after BBA
+        fuse_dec = tpke_pub.threshold == coin_pub.threshold
+        dec_subsets: List[list] = []
+
+        def run_rounds(rnd_list, inst_list, dec=False):
+            """Issue + fused verify/combine + toss for every
+            (inst, rnd) pair — two dispatches total; fills coin_bits.
+            With ``dec``, the decrypt wave's issues and combines ride
+            the same two dispatches."""
             nonlocal coin_issues, coin_verifies
             items = []
             metas = []
@@ -262,12 +282,22 @@ class LockstepCluster:
                         items.append(
                             (sec, base, context, coin_vks[sec.index - 1])
                         )
+            n_coin = len(items)
+            if dec:
+                items = items + dec_items
             shares = issue_shares_batch(
                 items, group=group, backend=backend, mesh=mesh
             )
-            coin_issues += len(items)
+            coin_issues += n_coin
+            if dec:
+                dec_shares = shares[n_coin:]
+                dec_subsets.extend(
+                    dec_shares[i * n : i * n + tpke_pub.threshold]
+                    for i in range(len(cts))
+                )
             # receivers verify the first f+1 pooled shares per
-            # instance (the honest-case minimum), one dispatch
+            # instance (the honest-case minimum) and combine the same
+            # subset — one fused dispatch for both
             groups = []
             subsets = []
             for mi, (inst, rnd, coin_id, pub, base, context) in enumerate(
@@ -276,32 +306,39 @@ class LockstepCluster:
                 sub = shares[mi * n : mi * n + (f + 1)]
                 subsets.append(sub)
                 groups.append((pub, base, sub, context))
-            verdicts = verify_share_groups(
-                groups, backend=backend, mesh=mesh
+            verdicts, _sigmas, _dec_vals = verify_and_combine_share_groups(
+                groups,
+                coin_pub.threshold,
+                backend=backend,
+                mesh=mesh,
+                combine_only_sets=dec_subsets if dec else (),
+                combine_only_group=group,
             )
             coin_verifies += sum(len(v) for v in verdicts)
             if not all(all(v) for v in verdicts):
                 raise AssertionError("honest coin share failed CP check")
-            combine_shares_batch(
-                subsets,
-                coin_pub.threshold,
-                group=group,
-                backend=backend,
-                mesh=mesh,
-            )
             for (inst, rnd, coin_id, *_rest), sub in zip(metas, subsets):
+                # pure memo hit on the fused combine: no dispatch
                 coin_bits[(inst, rnd)] = self.coin.toss(coin_id, sub)
 
-        run_rounds(range(SPEC_ROUNDS), undecided)  # the speculative block
-        for rnd in range(MAX_COIN_ROUNDS):
-            if not undecided:
-                break
-            rounds_used = rnd + 1
-            if (undecided[0], rnd) not in coin_bits:
-                run_rounds([rnd], undecided)  # past the window: tiny
-            undecided = [
-                inst for inst in undecided if not coin_bits[(inst, rnd)]
-            ]
+        next_rnd = 0
+        block = 1
+        while undecided and next_rnd < MAX_COIN_ROUNDS:
+            rnds = range(
+                next_rnd, min(next_rnd + block, MAX_COIN_ROUNDS)
+            )
+            run_rounds(rnds, undecided, dec=fuse_dec and next_rnd == 0)
+            for rnd in rnds:
+                rounds_used = rnd + 1
+                undecided = [
+                    inst
+                    for inst in undecided
+                    if not coin_bits[(inst, rnd)]
+                ]
+                if not undecided:
+                    break
+            next_rnd = rnds.stop
+            block = block * 2 if next_rnd > 1 else 1
         if undecided:
             raise AssertionError(
                 f"instances undecided after {MAX_COIN_ROUNDS} rounds"
@@ -310,43 +347,38 @@ class LockstepCluster:
         stats["bba_rounds"] = rounds_used
         stats["coin_issues"] = coin_issues
         stats["coin_verifies"] = coin_verifies
+        # attribution note: with dec_fused=1 the decrypt wave's device
+        # work is timed inside bba_s (it rides round 0's dispatches)
+        # and decrypt_s measures only the memo-hit tail — not
+        # comparable with pre-fusion artifacts' decrypt_s
+        stats["dec_fused"] = float(fuse_dec)
 
-        # ---- decrypt: N^2 share issues + N optimistic combines ----
+        # ---- decrypt tail: combines are memo hits from round 0 ----
         t0 = time.perf_counter()
-        tpke_pub = self.tpke.pub
-        tpke_vks = tpke_pub.verification_keys
-        cts = [deserialize_ciphertext(v, group) for v in delivered]
-        items = []
-        for ct in cts:
-            context = self.tpke.context(ct)
-            for nid in ids:
-                sec = self.keys[nid].tpke_share
-                items.append(
-                    (sec, ct.c1, context, tpke_vks[sec.index - 1])
-                )
-        dec_shares = issue_shares_batch(
-            items, group=group, backend=backend, mesh=mesh
-        )
-        # optimistic combine (protocol.honeybadger._try_decrypt): the
-        # ciphertext tag authenticates the KEM value, so the honest
-        # case spends zero CP verifications on decryption shares
-        subsets = [
-            dec_shares[i * n : i * n + tpke_pub.threshold]
-            for i in range(n)
-        ]
-        combine_shares_batch(
-            subsets,
-            tpke_pub.threshold,
-            group=group,
-            backend=backend,
-            mesh=mesh,
-        )
+        if not fuse_dec:
+            dec_shares = issue_shares_batch(
+                dec_items, group=group, backend=backend, mesh=mesh
+            )
+            dec_subsets.extend(
+                dec_shares[i * n : i * n + tpke_pub.threshold]
+                for i in range(len(cts))
+            )
+            # optimistic combine (protocol.honeybadger._try_decrypt):
+            # the ciphertext tag authenticates the KEM value, so the
+            # honest case spends zero CP verifications on dec shares
+            combine_shares_batch(
+                dec_subsets,
+                tpke_pub.threshold,
+                group=group,
+                backend=backend,
+                mesh=mesh,
+            )
         decrypted: Dict[str, List[bytes]] = {}
-        for i, (ct, sub) in enumerate(zip(cts, subsets)):
+        for i, (ct, sub) in enumerate(zip(cts, dec_subsets)):
             plain = self.tpke.combine(ct, sub)  # memo hit + tag check
             decrypted[ids[i]] = deserialize_txs(plain)
         stats["decrypt_s"] = time.perf_counter() - t0
-        stats["dec_issues"] = len(items)
+        stats["dec_issues"] = len(dec_items)
 
         # ---- commit: the reference dedup/ordering rule ----
         # (protocol.honeybadger._maybe_commit)

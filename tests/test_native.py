@@ -103,3 +103,37 @@ def test_hbbft_epoch_on_cpp_backend():
     net.run()
     assert_identical_batches(nodes)
 
+
+
+class TestSha256Rows:
+    def test_matches_hashlib_fixed_and_var(self):
+        import hashlib
+
+        import numpy as np
+
+        from cleisthenes_tpu.ops.hashrows import sha256_rows
+
+        rng = np.random.default_rng(3)
+        rows = rng.integers(0, 256, size=(97, 131), dtype=np.uint8)
+        got = sha256_rows(rows)
+        for i in (0, 50, 96):
+            assert got[i].tobytes() == hashlib.sha256(rows[i].tobytes()).digest()
+        lens = rng.integers(0, 132, size=97)
+        got = sha256_rows(rows, lens)
+        for i in (0, 13, 96):
+            assert (
+                got[i].tobytes()
+                == hashlib.sha256(rows[i, : int(lens[i])].tobytes()).digest()
+            )
+
+    def test_rejects_out_of_range_lens(self):
+        import numpy as np
+        import pytest
+
+        from cleisthenes_tpu.ops.hashrows import sha256_rows
+
+        rows = np.zeros((2, 8), dtype=np.uint8)
+        with pytest.raises(ValueError):
+            sha256_rows(rows, np.array([1, 9]))
+        with pytest.raises(ValueError):
+            sha256_rows(rows, np.array([-1, 4]))

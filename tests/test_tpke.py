@@ -213,3 +213,110 @@ class TestGroupMembership:
         pub, _ = tpke.deal(4, 2, seed=5)
         ct = tpke.Tpke(pub).encrypt(b"honest")
         assert deserialize_ciphertext(serialize_ciphertext(ct)) == ct
+
+
+class TestBatchedChallenge:
+    """The batched CP-challenge path (ops/hashrows + _cp_challenge_batch)
+    must stay byte-identical to the scalar _hash_to_int transcript —
+    this equivalence is what lets shares issued by the batched path
+    verify under the scalar path and vice versa."""
+
+    def test_cp_challenge_batch_matches_scalar(self):
+        import secrets as _s
+
+        gp = mm.DEFAULT_GROUP
+        nb = gp.nbytes
+        ctxs, bases, his, ds, a1s, a2s = [], [], [], [], [], []
+        for i in range(50):
+            # mixed context lengths exercise the group-by-length path
+            ctxs.append(b"ctx|%d" % (10 ** (i % 4)))
+            for lst in (bases, his, ds, a1s, a2s):
+                lst.append(int.from_bytes(_s.token_bytes(nb), "big") % gp.p)
+        got = tpke._cp_challenge_batch(ctxs, bases, his, ds, a1s, a2s, gp)
+        for k in range(50):
+            want = (
+                tpke._hash_to_int(
+                    b"cp", ctxs[k],
+                    tpke._ibytes(bases[k], nb), tpke._ibytes(his[k], nb),
+                    tpke._ibytes(ds[k], nb), tpke._ibytes(a1s[k], nb),
+                    tpke._ibytes(a2s[k], nb),
+                )
+                % gp.q
+            )
+            assert got[k] == want
+
+    def test_batched_issue_verifies_under_scalar_path(self):
+        pub, shares = tpke.deal(n=5, threshold=2, seed=77)
+        base = tpke.hash_to_group(b"cross-check")
+        ctx = b"cross|ctx"
+        out = tpke.issue_shares_batch(
+            [(s, base, ctx, pub.verification_keys[s.index - 1]) for s in shares]
+        )
+        # scalar verifier accepts every batched-issued share
+        assert all(tpke.verify_shares(pub, base, out, ctx))
+        # and the scalar-issued share verifies under the batched path
+        one = tpke.issue_share(shares[0], base, ctx)
+        v, _, _ = tpke.verify_and_combine_share_groups(
+            [(pub, base, [one] + out[1:], ctx)], 2
+        )
+        assert all(v[0])
+
+
+class TestFusedVerifyCombine:
+    def test_fused_matches_separate_ops(self):
+        pub, shares = tpke.deal(n=7, threshold=3, seed=42)
+        groups = []
+        for i in range(4):
+            ctx = b"g|%d" % i
+            base = tpke.hash_to_group(b"b|%d" % i)
+            out = tpke.issue_shares_batch(
+                [(s, base, ctx, pub.verification_keys[s.index - 1])
+                 for s in shares]
+            )
+            groups.append((pub, base, out, ctx))
+        v1 = tpke.verify_share_groups(groups)
+        c1 = tpke.combine_shares_batch([g[2][:3] for g in groups], 3)
+        tpke._COMBINE_MEMO.clear()
+        v2, c2, _ = tpke.verify_and_combine_share_groups(groups, 3)
+        assert v1 == v2 and c1 == c2
+        # memo is seeded: a follow-up scalar combine is a pure hit
+        assert tpke.combine_shares(groups[0][2][:3], 3) == c2[0]
+
+    def test_fused_combine_only_sets(self):
+        pub, shares = tpke.deal(n=6, threshold=3, seed=43)
+        base = tpke.hash_to_group(b"co")
+        ctx = b"co|ctx"
+        out = tpke.issue_shares_batch(
+            [(s, base, ctx, pub.verification_keys[s.index - 1])
+             for s in shares]
+        )
+        want = tpke.combine_shares_batch([out[:3], out[2:5]], 3)
+        tpke._COMBINE_MEMO.clear()
+        # equal-but-distinct group object must still combine (keyed by
+        # value, not identity)
+        gp2 = mm.GroupParams(p=mm.P, q=mm.Q, g=mm.G)
+        v, gvals, co = tpke.verify_and_combine_share_groups(
+            [(pub, base, out, ctx)],
+            3,
+            combine_only_sets=[out[:3], out[2:5]],
+            combine_only_group=gp2,
+        )
+        assert all(v[0])
+        assert co == want
+
+    def test_fused_flags_tampered_share(self):
+        pub, shares = tpke.deal(n=5, threshold=2, seed=44)
+        base = tpke.hash_to_group(b"tamper")
+        ctx = b"t|ctx"
+        out = tpke.issue_shares_batch(
+            [(s, base, ctx, pub.verification_keys[s.index - 1])
+             for s in shares]
+        )
+        bad = list(out)
+        bad[2] = tpke.DhShare(
+            index=bad[2].index, d=bad[2].d, e=bad[2].e, z=bad[2].z + 1
+        )
+        v, _, _ = tpke.verify_and_combine_share_groups(
+            [(pub, base, bad, ctx)], 2
+        )
+        assert v[0] == [True, True, False, True, True]
