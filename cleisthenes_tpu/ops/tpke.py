@@ -34,7 +34,7 @@ import functools
 import hashlib
 import hmac
 import secrets
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -86,6 +86,18 @@ def _cp_challenge_batch(
     if m == 0:
         return []
     nb, q = group.nbytes, group.q
+    if m < 64:
+        # matrix assembly costs more than it saves on the live path's
+        # small hub flushes; identical bytes either way
+        return [
+            _hash_to_int(
+                b"cp", contexts[i], _ibytes(bases[i], nb),
+                _ibytes(his[i], nb), _ibytes(ds[i], nb),
+                _ibytes(a1s[i], nb), _ibytes(a2s[i], nb),
+            )
+            % q
+            for i in range(m)
+        ]
     cols = [
         ints_to_be_rows(vals, nb)
         for vals in (bases, his, ds, a1s, a2s)
@@ -215,10 +227,13 @@ class ThresholdSecretShare:
     value: int  # s_i
 
 
-@dataclasses.dataclass(frozen=True)
-class DhShare:
+class DhShare(NamedTuple):
     """d = base^{s_i} plus a Chaum-Pedersen proof (e, z) that
-    log_g(h_i) == log_base(d)."""
+    log_g(h_i) == log_base(d).
+
+    A NamedTuple, not a dataclass: a live N=64 epoch creates ~1M of
+    these and frozen-dataclass ``__init__`` was a visible profile
+    line."""
 
     index: int
     d: int
@@ -596,16 +611,17 @@ def verify_and_combine_share_groups(
             backend if gp.p.bit_length() <= 256 else "cpu", mesh, gp
         )
         # verification duals first (2 per share), then combine terms
-        # (threshold per group) ride the same dispatch as u2^0 = 1
+        # (threshold per set) ride the same dispatch as u2^0 = 1
         # dummy-factor duals
         u1, e1, u2, e2 = _verify_dual_items(gp, groups, idx_list)
         n_dual = len(u1)
-        comb_spans: List[tuple] = []  # (gi, memo_key, n_terms)
-        for gi in idx_list:
-            pub, _base, shares, _context = groups[gi]
-            if len(shares) < threshold:
-                values[gi] = None
-                continue
+        comb_spans: List[tuple] = []  # (store(value), memo_key)
+
+        def queue_combine(shares, store) -> None:
+            """Memo-hit now or queue threshold Lagrange terms; the
+            post-dispatch loop below routes the product to ``store``.
+            One body for both the verified groups and the
+            combine-only sets — they cannot drift."""
             use = sorted(shares, key=lambda s: s.index)[:threshold]
             xs = [s.index for s in use]
             if len(set(xs)) != len(xs):
@@ -613,47 +629,35 @@ def verify_and_combine_share_groups(
             key = (gp, threshold, tuple((s.index, s.d) for s in use))
             hit = _COMBINE_MEMO.get(key)
             if hit is not None:
-                values[gi] = hit
-                continue
+                store(hit)
+                return
             lams = lagrange_coeff_at_zero(xs, gp.q)
             for sh, lam in zip(use, lams):
                 u1.append(sh.d % gp.p); e1.append(lam)
                 u2.append(1); e2.append(0)
-            comb_spans.append((gi, key, threshold))
-        co_spans: List[tuple] = []  # (set_idx, memo_key)
+            comb_spans.append((store, key))
+
+        for gi in idx_list:
+            pub, _base, shares, _context = groups[gi]
+            if len(shares) < threshold:
+                values[gi] = None
+                continue
+            queue_combine(
+                shares, lambda v, gi=gi: values.__setitem__(gi, v)
+            )
         if gp == co_gp:  # equality, not identity: by_gp keys by value
             for ci, shares in enumerate(combine_only_sets):
                 if len(shares) < threshold:
                     raise ValueError(
                         f"need >= {threshold} shares, got {len(shares)}"
                     )
-                use = sorted(shares, key=lambda s: s.index)[:threshold]
-                xs = [s.index for s in use]
-                if len(set(xs)) != len(xs):
-                    raise ValueError("duplicate share indices")
-                key = (gp, threshold, tuple((s.index, s.d) for s in use))
-                hit = _COMBINE_MEMO.get(key)
-                if hit is not None:
-                    co_values[ci] = hit
-                    continue
-                lams = lagrange_coeff_at_zero(xs, gp.q)
-                for sh, lam in zip(use, lams):
-                    u1.append(sh.d % gp.p); e1.append(lam)
-                    u2.append(1); e2.append(0)
-                co_spans.append((ci, key))
+                queue_combine(
+                    shares, lambda v, ci=ci: co_values.__setitem__(ci, v)
+                )
         a = eng.dual_pow_batch(u1, e1, u2, e2)
         verdicts.update(_cp_verdicts(gp, groups, idx_list, a))
         off = n_dual
-        for gi, key, n_terms in comb_spans:
-            acc = 1
-            for term in a[off : off + n_terms]:
-                acc = acc * term % gp.p
-            off += n_terms
-            if len(_COMBINE_MEMO) >= _COMBINE_MEMO_CAP:
-                _COMBINE_MEMO.clear()
-            _COMBINE_MEMO[key] = acc
-            values[gi] = acc
-        for ci, key in co_spans:
+        for store, key in comb_spans:
             acc = 1
             for term in a[off : off + threshold]:
                 acc = acc * term % gp.p
@@ -661,7 +665,7 @@ def verify_and_combine_share_groups(
             if len(_COMBINE_MEMO) >= _COMBINE_MEMO_CAP:
                 _COMBINE_MEMO.clear()
             _COMBINE_MEMO[key] = acc
-            co_values[ci] = acc
+            store(acc)
     return (
         [verdicts[gi] for gi in range(len(groups))],
         [values[gi] for gi in range(len(groups))],
@@ -707,26 +711,55 @@ class SharePool:
     two consumers of threshold shares in HBBFT.
     """
 
+    __slots__ = ("threshold", "_pending", "_verified", "_burned",
+                 "_seen", "_lazy", "_n")
+
     def __init__(self, threshold: int):
         self.threshold = threshold
         self._pending: Dict[str, DhShare] = {}
         self._verified: Dict[str, DhShare] = {}
         self._burned: set = set()
+        # one membership set over pending+verified+burned+lazy: the
+        # add paths make a single probe instead of three
+        self._seen: set = set()
+        # lazily-parked (sender, index, d, e, z) rows: the live path's
+        # wave handlers park ~N shares per pool but only ~threshold
+        # ever get consumed — DhShare objects materialize on first
+        # structured access, so arrival cost is probe+append
+        self._lazy: List[tuple] = []
+        self._n = 0  # pending+verified+lazy (burns decrement)
 
     def add(self, sender: str, share: DhShare) -> bool:
         """First share per non-burned sender wins."""
-        if (
-            sender in self._pending
-            or sender in self._verified
-            or sender in self._burned
-        ):
+        if sender in self._seen:
             return False
+        self._seen.add(sender)
         self._pending[sender] = share
+        self._n += 1
         return True
+
+    def add_lazy(
+        self, sender: str, index: int, d: int, e: int, z: int
+    ) -> bool:
+        """``add`` without constructing the DhShare: the batched wave
+        handlers' per-share fast path."""
+        if sender in self._seen:
+            return False
+        self._seen.add(sender)
+        self._lazy.append((sender, index, d, e, z))
+        self._n += 1
+        return True
+
+    def _materialize(self) -> None:
+        if self._lazy:
+            pending = self._pending
+            for sender, index, d, e, z in self._lazy:
+                pending[sender] = DhShare(index, d, e, z)
+            self._lazy.clear()
 
     def __len__(self) -> int:
         """Potential size: pending + verified (the threshold trigger)."""
-        return len(self._pending) + len(self._verified)
+        return self._n
 
     def collect_pending(
         self, limit: Optional[int] = None
@@ -742,6 +775,7 @@ class SharePool:
         the CP checks per pool); if a collected share fails, the next
         flush pulls replacements from the parked surplus.
         """
+        self._materialize()
         if limit is None:
             senders = list(self._pending)
         else:
@@ -778,6 +812,7 @@ class SharePool:
                 self._verified[sender] = share
             else:
                 self._burned.add(sender)
+                self._n -= 1
 
     def ready(self) -> Optional[List[DhShare]]:
         """>= threshold index-distinct verified shares, or None."""
@@ -799,6 +834,7 @@ class SharePool:
         some selected share was invalid, and the caller falls back to
         the verified path, which burns the culprit.  NOT safe for the
         common coin — its combined value has no independent check."""
+        self._materialize()
         by_index: Dict[int, DhShare] = {}
         for share in self._verified.values():
             by_index.setdefault(share.index, share)

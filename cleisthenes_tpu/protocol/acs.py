@@ -51,6 +51,7 @@ class ACS:
         coin_secret: ThresholdSecretShare,
         out,
         hub=None,
+        coin_issue_sink=None,
     ) -> None:
         self.n = config.n
         self.f = config.f
@@ -99,6 +100,7 @@ class ACS:
                 hub=hub,
                 bank=self.bank,
                 index=index,
+                coin_issue_sink=coin_issue_sink,
             )
             bba.on_decide = self._on_bba_decide
             self.bbas[proposer] = bba

@@ -106,6 +106,7 @@ class BBA:
         hub=None,
         bank=None,
         index: Optional[int] = None,
+        coin_issue_sink: Optional[Callable] = None,
     ) -> None:
         self.n = config.n
         self.f = config.f
@@ -125,6 +126,10 @@ class BBA:
         self.coin = coin
         self.coin_secret = coin_secret
         self.out = out
+        # when set, coin-share issuance defers to the owner's
+        # per-drain batch (one exponentiation dispatch for a whole
+        # wave of instances) instead of 4 scalar host exps here
+        self.coin_issue_sink = coin_issue_sink
         if hub is None:  # standalone use (unit tests): private hub
             from cleisthenes_tpu.ops.backend import BatchCrypto
             from cleisthenes_tpu.protocol.hub import CryptoHub
@@ -143,6 +148,7 @@ class BBA:
         self.halted = False
         self.on_decide: Optional[Callable[[str, bool], None]] = None
 
+        self._coin_threshold = coin.pub.threshold
         self._rounds: Dict[int, _Round] = {0: _Round(coin.pub.threshold)}
         self._term_sent = False
         self._term_recv: Dict[bool, Set[str]] = {True: set(), False: set()}
@@ -360,12 +366,22 @@ class BBA:
         if r.coin_share_sent or not self._aux_quorum():
             return
         r.coin_share_sent = True
+        if self.coin_issue_sink is not None:
+            # the drain batches every queued instance's issue into one
+            # dispatch and calls broadcast_coin_share back
+            self.coin_issue_sink(self, self.round)
+            return
         share = self.coin.share(self.coin_secret, self._coin_id(self.round))
+        self.broadcast_coin_share(self.round, share)
+
+    def broadcast_coin_share(self, rnd: int, share) -> None:
+        if self.halted:
+            return
         self.out.broadcast(
             CoinPayload(
                 proposer=self.proposer,
                 epoch=self.epoch,
-                round=self.round,
+                round=rnd,
                 index=share.index,
                 d=share.d,
                 e=share.e,
@@ -382,9 +398,15 @@ class BBA:
         r = self._cur()
         if r.coin_value is not None or not (1 <= index <= self.n):
             return
-        if r.coin_shares.add(sender, DhShare(index=index, d=d, e=e, z=z)):
-            self.hub.mark_dirty(self)
-            self._maybe_reveal_coin()
+        if r.coin_shares.add_lazy(sender, index, d, e, z):
+            # below the threshold there is nothing a hub flush could
+            # usefully verify for this pool — defer the dirty mark
+            # (and the DhShare materialization) until the coin can
+            # actually reveal; the post-burn replacement path re-marks
+            # explicitly in _on_coin_verdicts
+            if len(r.coin_shares) >= self._coin_threshold:
+                self.hub.mark_dirty(self)
+                self._maybe_reveal_coin()
 
     def _maybe_reveal_coin(self) -> None:
         """Threshold reached -> flush the hub: OUR shares verify in the

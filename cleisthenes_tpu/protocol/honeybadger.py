@@ -45,6 +45,7 @@ from cleisthenes_tpu.ops.tpke import (
     ThresholdPublicKey,
     ThresholdSecretShare,
     Tpke,
+    issue_shares_batch,
 )
 from cleisthenes_tpu.protocol.acs import ACS
 from cleisthenes_tpu.utils.log import NodeLogger
@@ -246,6 +247,7 @@ class _EpochState:
         "dec_shares",
         "decrypted",
         "opt_failed",
+        "opt_short",
         "committed",
     )
 
@@ -262,6 +264,11 @@ class _EpochState:
         # proposers whose optimistic (unverified-subset) combine hit a
         # bad tag: their shares take the CP-verified path instead
         self.opt_failed: Set[str] = set()
+        # proposers whose pool hit the size threshold without enough
+        # DISTINCT Shamir indices (duplicate-index share from a
+        # Byzantine sender): later adds must keep re-probing, the
+        # exact-crossing trigger alone would stall them forever
+        self.opt_short: Set[str] = set()
         self.committed = False
 
 
@@ -330,6 +337,7 @@ class HoneyBadger:
         self.hub.register((node_id, "hb"), self)  # permanent: dec-share pools
 
         self.que = TxQueue()
+        self._pending_coin_issues: List[tuple] = []
         self.epoch = 0
         # b = max(batchSize, n) (reference honeybadger.go:36-49)
         self.b = max(config.batch_size, config.n)
@@ -466,7 +474,13 @@ class HoneyBadger:
         """Transport idle callback: run the crypto flush the wave
         requested (quorum events only record the want in deferred
         mode), then ship everything it produced."""
+        self._drain_coin_issues()
         self.hub.run_deferred()
+        # the flush itself can advance rounds and queue NEW coin
+        # issues (coin reveal -> advance -> next round's aux quorum);
+        # drain again so they ride this turn's bundle, not the next
+        # inbound message's
+        self._drain_coin_issues()
         self._coalesce.flush()
 
     def _exit_turn(self) -> None:
@@ -474,7 +488,43 @@ class HoneyBadger:
         buffered outbound behind (transports without idle callbacks
         would otherwise strand the turn's messages)."""
         if not self._transport_managed:
+            self._drain_coin_issues()
             self._coalesce.flush()
+
+    def _queue_coin_issue(self, bba, rnd: int) -> None:
+        """BBA coin_issue_sink: park the (instance, round) want; the
+        turn-exit / idle drain issues every parked share in ONE
+        batched exponentiation dispatch instead of 4 scalar host exps
+        per instance (a vote wave triggers a whole roster's worth of
+        aux quorums at once)."""
+        self._pending_coin_issues.append((bba, rnd))
+
+    def _drain_coin_issues(self) -> None:
+        pend = self._pending_coin_issues
+        if not pend:
+            return
+        self._pending_coin_issues = []
+        group = self.keys.coin_pub.group
+        vks = self.keys.coin_pub.verification_keys
+        sec = self.keys.coin_share
+        items = []
+        metas = []
+        for bba, rnd in pend:
+            if bba.halted:
+                continue
+            _pub, base, context = bba.coin.group_params(bba._coin_id(rnd))
+            items.append((sec, base, context, vks[sec.index - 1]))
+            metas.append((bba, rnd))
+        if not items:
+            return
+        shares = issue_shares_batch(
+            items,
+            group=group,
+            backend=self.crypto.engine_backend,
+            mesh=self.crypto.mesh,
+        )
+        for (bba, rnd), share in zip(metas, shares):
+            bba.broadcast_coin_share(rnd, share)
 
     # -- message demux (transport Handler) ---------------------------------
 
@@ -570,6 +620,7 @@ class HoneyBadger:
                 coin_secret=self.keys.coin_share,
                 out=self.out,
                 hub=self.hub,
+                coin_issue_sink=self._queue_coin_issue,
             )
             acs.on_output = self._on_acs_output
             es = _EpochState(acs)
@@ -640,7 +691,7 @@ class HoneyBadger:
         pool = es.dec_shares.setdefault(
             proposer, SharePool(self.keys.tpke_pub.threshold)
         )
-        if not pool.add(sender, DhShare(index=index, d=d, e=e, z=z)):
+        if not pool.add_lazy(sender, index, d, e, z):
             return
         self._try_decrypt(epoch, es, proposer)
         self._maybe_commit(epoch, es)
@@ -663,6 +714,7 @@ class HoneyBadger:
         pools = es.dec_shares
         threshold = self.keys.tpke_pub.threshold
         dcol, ecol, zcol = payload.d, payload.e, payload.z
+        opt_failed = es.opt_failed
         touched = []
         for i, proposer in enumerate(payload.proposers):
             if proposer not in member:
@@ -670,10 +722,23 @@ class HoneyBadger:
             pool = pools.get(proposer)
             if pool is None:
                 pool = pools.setdefault(proposer, SharePool(threshold))
-            if pool.add(
-                sender, DhShare(index=index, d=dcol[i], e=ecol[i], z=zcol[i])
-            ):
-                touched.append(proposer)
+            if pool.add_lazy(sender, index, dcol[i], ecol[i], zcol[i]):
+                # decrypt probes only on the threshold CROSSING (below
+                # it nothing can combine; above it the only consumers
+                # of fresh shares are a flagged pool needing CP-path
+                # replacements and an index-short pool awaiting a
+                # distinct Shamir index).  Missed-window cases re-probe
+                # via _on_acs_output (output arrives after crossing)
+                # and _on_dec_verdicts (burn with replacements parked).
+                n_pool = len(pool)
+                if n_pool == threshold or (
+                    n_pool > threshold
+                    and (
+                        proposer in opt_failed
+                        or proposer in es.opt_short
+                    )
+                ):
+                    touched.append(proposer)
         if not touched:
             return
         for proposer in touched:
@@ -700,7 +765,11 @@ class HoneyBadger:
         if proposer not in es.opt_failed:
             subset = pool.optimistic_subset()
             if subset is None:
+                # size threshold met but too few distinct indices —
+                # keep the batched handler probing on later adds
+                es.opt_short.add(proposer)
                 return
+            es.opt_short.discard(proposer)
             try:
                 plain = self.tpke.combine(ct, subset)
             except ValueError:  # bad tag: an invalid share slipped in

@@ -46,6 +46,14 @@ from cleisthenes_tpu.transport.message import RbcPayload, RbcType
 # transport.message.MAX_FIELD_BYTES).
 MAX_SHARD_BYTES = 16 * 1024 * 1024
 
+# id-keyed branch-shape memo: entries hold the branch TUPLE (a few
+# hundred bytes — pinning the id against recycling, same discipline as
+# the hub's token table) rather than the whole payload, whose shard
+# bytes would otherwise keep dead epochs' data resident until the
+# wholesale clear at the cap
+_BRANCH_SHAPE_MEMO: dict = {}
+_BRANCH_SHAPE_MEMO_CAP = 1 << 14
+
 
 class RBC:
     """One reliable-broadcast instance: (epoch, proposer).
@@ -105,6 +113,13 @@ class RBC:
         # verification has burned its one vote.
         self._echo_voted: Set[str] = set()
         self._ready_voted: Set[str] = set()
+        # depth of the padded tree the proposer must have built
+        # (precomputed: _precheck runs once per delivered ECHO)
+        p = 1
+        self._depth = 0
+        while p < self.n:
+            p <<= 1
+            self._depth += 1
         # root -> sender -> payload awaiting batched branch verification
         self._pending_echo: Dict[bytes, Dict[str, RbcPayload]] = {}
         # root -> set of verified ECHO senders
@@ -177,22 +192,31 @@ class RBC:
     def _precheck(self, payload: RbcPayload) -> bool:
         """Structural validation — everything except the branch hash
         check itself (reference rbc/rbc.go:93-95 `validateMessage`
-        minus the crypto, which the hub batches)."""
+        minus the crypto, which the hub batches).
+
+        The branch-shape walk memoizes ON OBJECT IDENTITY: the codec's
+        payload memo shares one branch tuple across a broadcast's N
+        receivers, so the per-sibling length walk runs once per wire
+        payload, not once per delivery (the held tuple pins the id);
+        the remaining checks are a handful of scalar compares."""
         if not (0 <= payload.shard_index < self.n):
             return False
         if not (0 < len(payload.shard) <= MAX_SHARD_BYTES):
             return False
         if len(payload.root_hash) != 32:
             return False
-        # depth of the padded tree the proposer must have built
-        p = 1
-        depth = 0
-        while p < self.n:
-            p <<= 1
-            depth += 1
-        if len(payload.branch) != depth:
+        branch = payload.branch
+        if len(branch) != self._depth:
             return False
-        if any(len(b) != 32 for b in payload.branch):
+        ent = _BRANCH_SHAPE_MEMO.get(id(branch))
+        if ent is not None and ent[0] is branch:
+            ok = ent[1]
+        else:
+            ok = all(len(b) == 32 for b in branch)
+            if len(_BRANCH_SHAPE_MEMO) >= _BRANCH_SHAPE_MEMO_CAP:
+                _BRANCH_SHAPE_MEMO.clear()
+            _BRANCH_SHAPE_MEMO[id(branch)] = (branch, ok)
+        if not ok:
             return False
         # Shards of one root must agree on length (RS needs a matrix).
         # _shard_len only ever holds BRANCH-VERIFIED lengths (set in
@@ -362,7 +386,8 @@ class RBC:
                         p.shard,
                         tuple(p.branch),
                         p.shard_index,
-                        self._make_echo_cb(root, sender, p),
+                        self,
+                        (root, sender, p),
                     )
                 )
         # staged decode requests with enough verified shards
@@ -380,26 +405,34 @@ class RBC:
             )
             decodes.append((idxs, mat, root, self._make_decode_cb(root)))
 
-    def _make_echo_cb(self, root: bytes, sender: str, p: RbcPayload):
-        def cb(ok: bool) -> None:
-            if self.delivered or not ok:
-                return  # invalid: the sender's one slot stays burned
+    def on_branch_verdicts(self, ctxs, oks) -> None:
+        """Bulk ECHO-branch verdicts from the hub (one call per flush
+        instead of a per-echo closure — at N=64 the closures alone
+        were ~1.8 s of an epoch).  ctx = (root, sender, payload)."""
+        if self.delivered:
+            return
+        shard_len = self._shard_len
+        echo_senders = self._echo_senders
+        shards = self._shards
+        re_mark = False
+        for (root, sender, p), ok in zip(ctxs, oks):
+            if not ok:
+                continue  # invalid: the sender's one slot stays burned
             # length authority comes only from verified shards; a
             # verified shard conflicting with the established length
             # is a Byzantine proposer mixing lengths under one tree —
             # drop it, RS needs a rectangular matrix
-            want = self._shard_len.setdefault(root, len(p.shard))
+            want = shard_len.setdefault(root, len(p.shard))
             if len(p.shard) != want:
-                return
-            self._echo_senders.setdefault(root, set()).add(sender)
-            self._shards.setdefault(root, {})[p.shard_index] = p.shard
-            # a staged decode may just have reached k shards — stay on
-            # the hub's dirty list for its next round (no decode
-            # staged -> nothing new to collect, skip the re-mark)
-            if self._decode_req:
-                self.hub.mark_dirty(self)
-
-        return cb
+                continue
+            echo_senders.setdefault(root, set()).add(sender)
+            shards.setdefault(root, {})[p.shard_index] = p.shard
+            re_mark = True
+        # a staged decode may just have reached k shards — stay on
+        # the hub's dirty list for its next round (no decode
+        # staged -> nothing new to collect, skip the re-mark)
+        if re_mark and self._decode_req:
+            self.hub.mark_dirty(self)
 
     def _make_decode_cb(self, root: bytes):
         def cb(data) -> None:

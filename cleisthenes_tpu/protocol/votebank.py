@@ -65,6 +65,10 @@ class VoteBank:
         self.aux_cnt = np.zeros((n_inst, 2), dtype=np.int32)
         # bin_flags[i, v]: v in instance i's current-round bin_values
         self.bin_flags = np.zeros((n_inst, 2), dtype=bool)
+        # edge-trigger memory: on_aux_quorum fires once per row (the
+        # post-quorum AUX stream at N=64 was ~220k redundant probes
+        # per epoch); bin_values growth re-probes via BBA directly
+        self.aux_fired = np.zeros(n_inst, dtype=bool)
         self.row_round = np.zeros(n_inst, dtype=np.int64)
         self.active = np.ones(n_inst, dtype=bool)
         self.bbas: List[object] = [None] * n_inst
@@ -82,6 +86,7 @@ class VoteBank:
         self.aux_seen[index] = False
         self.aux_cnt[index] = 0
         self.bin_flags[index] = False
+        self.aux_fired[index] = False
         self.row_round[index] = rnd
 
     def deactivate(self, index: int) -> None:
@@ -213,7 +218,14 @@ class VoteBank:
                 self.aux_cnt[new, 0] * self.bin_flags[new, 0]
             )
             n = len(self.members)
-            trig = new[good >= n - self.f]
+            trig = new[(good >= n - self.f) & ~self.aux_fired[new]]
+            if trig.size == 0:
+                return
+            # fire ONCE per row: post-quorum receipts change nothing
+            # the quorum path reads (advancement re-probes happen on
+            # coin reveal and bin growth, which have their own
+            # triggers); vals are read at advance time either way
+            self.aux_fired[trig] = True
             for i in trig:
                 bba = self.bbas[i]
                 if bba is not None and not bba.halted:

@@ -282,3 +282,32 @@ def test_byzantine_seeded_sweep():
             for b in next(iter(honest.values())).committed_batches
         )
         assert committed > 0, f"no progress at seed {seed} (bad={bad})"
+
+
+def test_byzantine_duplicate_index_dec_share_does_not_stall():
+    """Regression (round-4 review): the batched dec-share handler
+    probes decryption only on the pool-size threshold CROSSING.  A
+    Byzantine member replaying an HONEST node's share index makes the
+    pool hit the size threshold with too few distinct Shamir indices;
+    the epoch must still decrypt when real shares arrive later —
+    pre-fix the crossing was consumed and no later add re-probed,
+    stalling commit forever."""
+    from cleisthenes_tpu.ops.tpke import DhShare
+
+    cfg, net, nodes = make_hb_network(4, batch_size=8)  # FIFO scheduler
+    bad = "node0"  # sorts first: its share lands early in every pool
+    hb_bad = nodes[bad]
+    real_dec_share = hb_bad.tpke.dec_share
+
+    def replayed_index_share(share, ct):
+        good = real_dec_share(share, ct)
+        # claim another sender's index: a valid-looking duplicate that
+        # contributes no distinct interpolation point
+        return DhShare(index=2, d=good.d, e=good.e, z=good.z)
+
+    hb_bad.tpke.dec_share = replayed_index_share
+    push_txs(nodes, 12)
+    run_epochs(net, nodes)
+    assert_identical_batches(nodes)
+    committed = sum(len(b) for b in nodes["node1"].committed_batches)
+    assert committed >= 12  # liveness held despite the index replay

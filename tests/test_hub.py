@@ -93,6 +93,13 @@ class TestHubBatching:
         shards = rng.integers(0, 256, size=(3, 8, 64), dtype=np.uint8)
         trees = crypto.merkle.build_batch(shards)
         results = {}
+
+        class Sink:  # bulk-verdict client (the hub's branch contract)
+            def on_branch_verdicts(self, ctxs, oks):
+                for key, ok in zip(ctxs, oks):
+                    results[key] = ok
+
+        sink = Sink()
         items = []
         for t_i, t in enumerate(trees):
             for j in range(8):
@@ -105,7 +112,8 @@ class TestHubBatching:
                         leaf,
                         tuple(t.branch(j)),
                         j,
-                        lambda ok, key=(t_i, j): results.__setitem__(key, ok),
+                        sink,
+                        (t_i, j),
                     )
                 )
         hub._run_branches(items)
