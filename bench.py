@@ -259,6 +259,10 @@ def measure_protocol(backend: str, n: int, batch: int, epochs: int) -> dict:
     total_t = sum(epoch_times)
     return {
         "epoch_p50_ms": round(p50 * 1000.0, 3) if p50 is not None else None,
+        # raw per-epoch walls: relay drift (8 s -> 28 s inside one
+        # session was observed in round 3) must be visible in the
+        # artifact itself, not only in the evidence doc
+        "epoch_times_ms": [round(t * 1000.0, 1) for t in epoch_times],
         "tx_per_sec": round(committed / total_t, 1) if total_t > 0 else None,
         "measured_epochs": len(epoch_times),
         # the hub is cluster-shared: this is ALL n validators'
@@ -302,10 +306,71 @@ def measure_spmd(backend: str, n: int, batch: int, epochs: int) -> dict:
     total_t = sum(times)
     return {
         "epoch_p50_ms": round(p50 * 1000.0, 3),
+        "epoch_times_ms": [round(t * 1000.0, 1) for t in times],
         "tx_per_sec": round(committed / total_t, 1) if total_t else None,
         "measured_epochs": epochs,
         "bba_rounds": rounds,
     }
+
+
+# ---------------------------------------------------------------------------
+# Wide-group modexp: the XLA limb families past 256 bits
+# ---------------------------------------------------------------------------
+
+# RFC 3526 MODP group 14 (2048-bit safe prime)
+_MODP14 = int(
+    "FFFFFFFFFFFFFFFFC90FDAA22168C234C4C6628B80DC1CD129024E088A67CC74"
+    "020BBEA63B139B22514A08798E3404DDEF9519B3CD3A431B302B0A6DF25F1437"
+    "4FE1356D6D51C245E485B576625E7EC6F44C42E9A637ED6B0BFF5CB6F406B7ED"
+    "EE386BFB5A899FA5AE9F24117C4B1FE649286651ECE45B3DC2007CB8A163BF05"
+    "98DA48361C55D39A69163FA8FD24CF5F83655D23DCA3AD961C62F356208552BB"
+    "9ED529077096966D670C354E4ABC9804F1746C08CA18217C32905E462E36CE3B"
+    "E39E772C180E86039B2783A2EC07A28FB5C55DF06F4C52C9DE2BCBF695581718"
+    "3995497CEA956AE515D2261898FA051015728E5A8AACAA68FFFFFFFFFFFFFFFF",
+    16,
+)
+
+
+def measure_modexp_wide() -> dict:
+    """exps/s of the wide XLA limb families (384-bit and 2048-bit
+    groups) vs the host comparator — python pow here, since the native
+    Montgomery kernel is 256-bit-only (round-3 verdict item 4: these
+    widths used to be REJECTED by the XLA engine)."""
+    from cleisthenes_tpu.ops import modmath as mm
+
+    rng = np.random.default_rng(29)
+    out = {}
+    for label, p, batch in (
+        ("384", mm.P384, 2048),  # the packaged 384-bit group's prime
+        ("2048", _MODP14, 128),
+    ):
+        group = mm.GroupParams(p=p, q=(p - 1) // 2, g=4)
+        eng = mm.get_engine("tpu", group=group)
+        bases = [
+            int.from_bytes(rng.bytes(group.nbytes), "big") % p
+            for _ in range(batch)
+        ]
+        exps = [
+            int.from_bytes(rng.bytes(group.nbytes), "big") % group.q
+            for _ in range(batch)
+        ]
+        got = eng.pow_batch(bases, exps)  # warm-up (compiles)
+        t0 = time.perf_counter()
+        eng.pow_batch(bases, exps)
+        dev_s = time.perf_counter() - t0
+        sample = max(batch // 16, 8)
+        t0 = time.perf_counter()
+        host = [pow(b, e, p) for b, e in zip(bases[:sample], exps[:sample])]
+        host_s = (time.perf_counter() - t0) * (batch / sample)
+        assert got[:sample] == host, f"{label}-bit device/host mismatch"
+        out[f"w{label}"] = {
+            "bits": int(label),
+            "batch": batch,
+            "device_exps_per_sec": round(batch / dev_s, 1),
+            "host_pow_exps_per_sec": round(batch / host_s, 1),
+            "vs_host": _vs(host_s * 1000.0, dev_s * 1000.0),
+        }
+    return out
 
 
 def _vs(cpu_ms, tpu_ms):
@@ -482,6 +547,21 @@ def run_child() -> None:
         print(f"[bench] {section} @ {time.strftime('%H:%M:%S')}",
               file=sys.stderr, flush=True)
 
+    def dispatch_ms() -> float:
+        """One tiny forced dispatch: the relay-health needle.  A
+        healthy relay round-trips ~40 ms; recording it at start AND
+        end makes intra-session relay drift (8 s -> 28 s epochs in
+        round 3) visible inside the artifact."""
+        import jax.numpy as jnp
+
+        t0 = time.perf_counter()
+        np.asarray(jnp.ones((8, 8)) @ jnp.ones((8, 8)))
+        return round((time.perf_counter() - t0) * 1000.0, 1)
+
+    provenance = {
+        "start_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "dispatch_ms_start": dispatch_ms(),
+    }
     cpu_ref = cpu_reference_backend()
     progress(f"platform={platform} ({device_kind}); crypto_n128 tpu")
     accel_p50 = measure_crypto("tpu")
@@ -583,6 +663,19 @@ def run_child() -> None:
             "vs_cpu": None,
             "note": "accelerated side skipped: no TPU attached",
         }
+    progress("modexp_wide")
+    if on_tpu:
+        out["modexp_wide"] = measure_modexp_wide()
+    else:
+        out["modexp_wide"] = {
+            "note": "skipped: no TPU attached (XLA-on-host wide-limb "
+            "numbers are meaningless and ~85 s of budget)"
+        }
+    provenance["end_utc"] = time.strftime(
+        "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+    )
+    provenance["dispatch_ms_end"] = dispatch_ms()
+    out["provenance"] = provenance
     print(json.dumps(out))
 
 

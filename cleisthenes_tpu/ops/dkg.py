@@ -70,7 +70,7 @@ import functools
 from cleisthenes_tpu.ops.modmath import (
     DEFAULT_GROUP,
     GroupParams,
-    get_engine,
+    get_engine_degraded,
 )
 from cleisthenes_tpu.ops.tpke import (
     ThresholdPublicKey,
@@ -142,9 +142,7 @@ class DkgDealing:
     def commitments(self, backend: str = "cpu", mesh=None) -> List[int]:
         """Feldman commitments C_k = g^{a_k} — broadcast publicly."""
         gp = self.group
-        eng = get_engine(
-            backend if gp.p.bit_length() <= 256 else "cpu", mesh, gp
-        )
+        eng = get_engine_degraded(backend, mesh, gp)
         return eng.pow_batch([gp.g] * len(self._coeffs), self._coeffs)
 
     def share_for(self, receiver_index: int) -> int:
@@ -180,9 +178,7 @@ class PedersenDealing(DkgDealing):
         hiding: reveals NOTHING about the a_k until phase two."""
         gp = self.group
         h = pedersen_generator(gp)
-        eng = get_engine(
-            backend if gp.p.bit_length() <= 256 else "cpu", mesh, gp
-        )
+        eng = get_engine_degraded(backend, mesh, gp)
         t = len(self._coeffs)
         pows = eng.pow_batch(
             [gp.g] * t + [h] * t, self._coeffs + self._coeffs2
@@ -213,9 +209,7 @@ def verify_pedersen_shares(
         return []
     gp = group
     h = pedersen_generator(gp)
-    eng = get_engine(
-        backend if gp.p.bit_length() <= 256 else "cpu", mesh, gp
-    )
+    eng = get_engine_degraded(backend, mesh, gp)
     bases: List[int] = []
     exps: List[int] = []
     spans: List[int] = []
@@ -338,9 +332,7 @@ def validate_commitments(
     "all-member", and a t' != t vector desynchronizes the flattened
     exponent batches of verify/finalize)."""
     gp = group
-    eng = get_engine(
-        backend if gp.p.bit_length() <= 256 else "cpu", mesh, gp
-    )
+    eng = get_engine_degraded(backend, mesh, gp)
     flat: List[int] = []
     spans: List[int] = []
     for commits in commitment_sets:
@@ -376,9 +368,7 @@ def verify_dealer_shares(
     if not items:
         return []
     gp = group
-    eng = get_engine(
-        backend if gp.p.bit_length() <= 256 else "cpu", mesh, gp
-    )
+    eng = get_engine_degraded(backend, mesh, gp)
     bases: List[int] = []
     exps: List[int] = []
     spans: List[int] = []
@@ -439,9 +429,7 @@ def finalize(
                 f"dealer {i}: {len(commits)} commitments != t={threshold}"
             )
     gp = group
-    eng = get_engine(
-        backend if gp.p.bit_length() <= 256 else "cpu", mesh, gp
-    )
+    eng = get_engine_degraded(backend, mesh, gp)
     x_j = sum(my_shares.values()) % gp.q
     master = 1
     for commits in all_commitments.values():
@@ -610,11 +598,7 @@ def run_dkg(
         # NOT disqualified: their secrets are already in x.
         # Reconstruct each f_i from t phase-one-verified shares and
         # open it ourselves — all dealers in ONE batched dispatch.
-        eng = get_engine(
-            backend if group.p.bit_length() <= 256 else "cpu",
-            mesh,
-            group,
-        )
+        eng = get_engine_degraded(backend, mesh, group)
         recon = sorted(bad_openings)
         all_coeffs: List[int] = []
         for i in recon:

@@ -44,7 +44,7 @@ from cleisthenes_tpu.ops.modmath import (
     GroupParams,
     P,
     Q,
-    get_engine,
+    get_engine_degraded,
     host_pow,
     host_pow_batch,
 )
@@ -329,9 +329,7 @@ def issue_shares_batch(
     """
     if not items:
         return []
-    eng = get_engine(
-        backend if group.p.bit_length() <= 256 else "cpu", mesh, group
-    )
+    eng = get_engine_degraded(backend, mesh, group)
     q, g = group.q, group.g
     nbytes = group.nbytes
     # Exponentiations grouped by base — a wave shares a handful of
@@ -408,9 +406,7 @@ def combine_shares_batch(
     ``combine_shares``, and shares its memo."""
     if not share_sets:
         return []
-    eng = get_engine(
-        backend if group.p.bit_length() <= 256 else "cpu", mesh, group
-    )
+    eng = get_engine_degraded(backend, mesh, group)
     results: List[Optional[int]] = [None] * len(share_sets)
     bases_flat: List[int] = []
     exps_flat: List[int] = []
@@ -475,9 +471,7 @@ def verify_share_groups(
         by_gp.setdefault(pub.group, []).append(gi)
     results: Dict[int, List[bool]] = {}
     for gp, idx_list in by_gp.items():
-        eng = get_engine(
-            backend if gp.p.bit_length() <= 256 else "cpu", mesh, gp
-        )
+        eng = get_engine_degraded(backend, mesh, gp)
         # NOTE: a comb-decomposed variant (g^z, h^{-e}, base^z grouped
         # fixed-base; d^{-e} generic; host recombination) was measured
         # SLOWER than this fused path at 4k checks (0.23 s vs 0.12 s
@@ -607,9 +601,7 @@ def verify_and_combine_share_groups(
     values: Dict[int, Optional[int]] = {}
     co_values: List[int] = [0] * len(combine_only_sets)
     for gp, idx_list in by_gp.items():
-        eng = get_engine(
-            backend if gp.p.bit_length() <= 256 else "cpu", mesh, gp
-        )
+        eng = get_engine_degraded(backend, mesh, gp)
         # verification duals first (2 per share), then combine terms
         # (threshold per set) ride the same dispatch as u2^0 = 1
         # dummy-factor duals

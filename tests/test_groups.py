@@ -97,9 +97,78 @@ def test_2048bit_modp14_cpu_only():
     _roundtrip(GROUP14, "cpu")
 
 
-def test_xla_engine_rejects_oversized_group():
-    with pytest.raises(ValueError, match="256-bit"):
-        get_engine("tpu", group=GROUP14)
+# The packaged 384-bit safe-prime group (BLS12-381 base-field width
+# class, (12, 32) XLA limb family) — see ops/modmath.GROUP384.
+from cleisthenes_tpu.ops.modmath import GROUP384, P384  # noqa: E402
+
+# Batches must clear ModEngine.HOST_FLOOR_NO_NATIVE (16): smaller
+# batches silently reroute to the host engine and the "device" test
+# compares python pow against python pow (round-4 review finding).
+WIDE_BATCH = 24
+
+
+def test_384bit_group_xla_engine_matches_pow(jax_cpu_devices):
+    """The wide XLA limb family (SURVEY §7 hard part 1: a group sized
+    for BLS12-381's base field on the device path, replacing round-3's
+    256-bit rejection)."""
+    import random
+
+    rng = random.Random(7)
+    eng = get_engine("tpu", group=GROUP384)
+    assert eng._host_floor(WIDE_BATCH) is None  # really the device path
+    bases = [rng.randrange(2, P384) for _ in range(2 * WIDE_BATCH)]
+    exps = [rng.randrange(1, GROUP384.q) for _ in range(2 * WIDE_BATCH)]
+    assert eng.pow_batch(bases, exps) == [
+        pow(b, e, P384) for b, e in zip(bases, exps)
+    ]
+    h = 2 * WIDE_BATCH // 2
+    got = eng.dual_pow_batch(bases[:h], exps[:h], bases[h:], exps[h:])
+    assert got == [
+        pow(a, x, P384) * pow(b, y, P384) % P384
+        for a, x, b, y in zip(bases[:h], exps[:h], bases[h:], exps[h:])
+    ]
+
+
+def test_384bit_group_full_protocol_xla(jax_cpu_devices):
+    """The whole TPKE + coin round-trip under the 384-bit group on the
+    XLA engine — the seam swap the module docstrings promise."""
+    _roundtrip(GROUP384, "tpu")
+
+
+def test_2048bit_modp14_xla_engine_matches_pow(jax_cpu_devices):
+    """Round-3 verdict item: the 2048-bit MODP-14 group runs on the
+    TPU path (11x192-limb family), property-matched against python
+    pow.  Replaces test_xla_engine_rejects_oversized_group."""
+    import random
+
+    rng = random.Random(5)
+    eng = get_engine("tpu", group=GROUP14)
+    assert eng.backend == "tpu"
+    assert eng._host_floor(WIDE_BATCH) is None  # really the device path
+    bases = [rng.randrange(2, GROUP14.p) for _ in range(WIDE_BATCH)]
+    exps = [rng.randrange(1, GROUP14.q) for _ in range(WIDE_BATCH)]
+    assert eng.pow_batch(bases, exps) == [
+        pow(b, e, GROUP14.p) for b, e in zip(bases, exps)
+    ]
+    h = WIDE_BATCH // 2
+    got = eng.dual_pow_batch(bases[:h], exps[:h], bases[h:], exps[h:])
+    assert got == [
+        pow(a, x, GROUP14.p) * pow(b, y, GROUP14.p) % GROUP14.p
+        for a, x, b, y in zip(bases[:h], exps[:h], bases[h:], exps[h:])
+    ]
+
+
+def test_xla_engine_still_rejects_beyond_every_family():
+    """layout_for_group must return None past the widest family (a
+    matching-anyway bug would silently TRUNCATE limbs instead of
+    raising)."""
+    from cleisthenes_tpu.ops.modmath import layout_for_group
+
+    p_huge = (1 << 3000) + 117  # odd, 3001 bits > 2112-bit family
+    g_huge = GroupParams(p=p_huge, q=(p_huge - 1) // 2, g=4)
+    assert layout_for_group(g_huge) is None
+    with pytest.raises(ValueError, match="limb family"):
+        get_engine("tpu", group=g_huge)
 
 
 def test_groups_are_isolated():
