@@ -5,10 +5,14 @@
 # travis/run_on_pull_requests.sh: goimports format gate, `go test -v`,
 # then `go test -race`), translated to this stack:
 #
-#   1. format/syntax gate  — compileall over package + tests (no
-#      third-party formatter is baked into the image; syntax+bytecode
-#      compilation is the deterministic equivalent gate)
-#   2. fast test tier      — pytest minus the multi-minute scale tests
+#   1. format/syntax gate  — compileall + tools/format_gate.py (the
+#      image bakes no third-party formatter; the gate enforces this
+#      tree's deterministic style invariants — parseability, LF, EOF
+#      newline, no tabs/trailing whitespace, <= 99 cols — stdlib-only)
+#   2. fast test tier      — pytest minus the multi-minute scale
+#      tests, under tools/covgate.py (PEP 669 line coverage; the
+#      tier must execute >= 85% of the package's executable lines —
+#      the travis pipeline's coverage upload, translated to a GATE)
 #   3. race-analog tier    — the seeded deterministic-scheduler suites
 #      (transport/byzantine), this stack's answer to `-race`
 #      (SURVEY.md §5.2: replayable interleavings instead of a dynamic
@@ -22,11 +26,13 @@
 set -euo pipefail
 cd "$(dirname "$0")"
 
-echo "== [1/4] syntax gate: compileall"
+echo "== [1/4] syntax + format gate"
 python -m compileall -q cleisthenes_tpu tests bench.py __graft_entry__.py
+python tools/format_gate.py
 
-echo "== [2/4] fast tests"
-python -m pytest tests/ -q -m "not slow" -x
+echo "== [2/4] fast tests (with coverage gate)"
+COVGATE_MIN="${COVGATE_MIN:-85}" \
+    python -m pytest tests/ -q -m "not slow" -x -p tools.covgate
 
 echo "== [3/4] race-analog: seeded-scheduler + threaded-transport suites"
 python -m pytest tests/test_transport.py tests/test_byzantine.py \
