@@ -1,4 +1,4 @@
-"""Relay watcher: re-capture BENCH_live_r03.json when the TPU returns.
+"""Relay watcher: re-capture BENCH_live_r04.json when the TPU returns.
 
 The axon relay dies and revives unpredictably (TPU_EVIDENCE_r03.md);
 this loop probes it on a long interval and, on a healthy window, runs
@@ -20,7 +20,7 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-ARTIFACT = os.path.join(REPO, "BENCH_live_r03.json")
+ARTIFACT = os.path.join(REPO, "BENCH_live_r04.json")
 PROBE_INTERVAL_S = 300
 PROBE_TIMEOUT_S = 45
 BENCH_TIMEOUT_S = 3600
