@@ -671,7 +671,13 @@ def run_child() -> None:
         }
     progress("modexp_wide")
     if on_tpu:
-        out["modexp_wide"] = measure_modexp_wide()
+        # first time these wide-limb programs meet a real chip: a
+        # pathological compile or relay death here must cost this
+        # SECTION, not the whole artifact
+        try:
+            out["modexp_wide"] = measure_modexp_wide()
+        except Exception as exc:  # noqa: BLE001 — recorded, not hidden
+            out["modexp_wide"] = {"error": repr(exc)[:300]}
     else:
         out["modexp_wide"] = {
             "note": "skipped: no TPU attached (XLA-on-host wide-limb "

@@ -166,6 +166,14 @@ def _lockstep_main(args, cfg: Config) -> int:
     from cleisthenes_tpu.protocol.spmd import LockstepCluster
 
     cluster = LockstepCluster(config=cfg)
+    if args.dkg:
+        # swap the dealer's threshold keys for DKG-generated ones
+        # before any traffic (the --dkg flag was silently ignored in
+        # lockstep mode until the round-4 review caught it)
+        cluster.keys = _dkg_rekey(cfg, cluster.ids, cluster.keys)
+        k0 = cluster.keys[cluster.ids[0]]
+        cluster.tpke = cluster.crypto.tpke(k0.tpke_pub)
+        cluster.coin = cluster.crypto.coin(k0.coin_pub)
     prefix = b"demo-%d" % time.time_ns()
     txs = [b"%s-tx-%05d" % (prefix, i) for i in range(args.txs)]
     for tx in txs:

@@ -1,0 +1,48 @@
+"""The demo CLI (cleisthenes_tpu/demo.py) driven in-process.
+
+The demo is the framework's app-facing entry; until round 4 it was
+exercised only by out-of-process smoke runs, leaving its whole body
+outside the coverage gate."""
+
+from cleisthenes_tpu import demo
+
+
+def test_demo_grpc_mode_commits_all(tmp_path):
+    rc = demo.main(
+        [
+            "--n", "4", "--txs", "16", "--batch-size", "8",
+            "--log-dir", str(tmp_path),
+        ]
+    )
+    assert rc == 0
+    # durable logs were written for every node
+    assert sum(1 for _ in tmp_path.iterdir()) >= 4
+
+
+def test_demo_lockstep_mode_commits_all():
+    assert demo.main(["--n", "4", "--txs", "12", "--mode", "lockstep"]) == 0
+
+
+def test_demo_lockstep_with_dkg_keys(capsys):
+    assert (
+        demo.main(
+            ["--n", "4", "--txs", "8", "--mode", "lockstep", "--dkg"]
+        )
+        == 0
+    )
+    # the DKG really ran (the flag was silently ignored in lockstep
+    # mode until the round-4 review): its banner is printed and the
+    # epoch decrypted under the DKG key set
+    out = capsys.readouterr().out
+    assert "DKG complete" in out and "SUCCESS" in out
+
+
+def test_demo_restart_resumes_from_logs(tmp_path):
+    """Second run against the same --log-dir must replay the durable
+    batches and keep committing (the restart/recovery surface)."""
+    args = [
+        "--n", "4", "--txs", "8", "--batch-size", "8",
+        "--log-dir", str(tmp_path),
+    ]
+    assert demo.main(args) == 0
+    assert demo.main(args) == 0

@@ -137,3 +137,36 @@ class TestSha256Rows:
             sha256_rows(rows, np.array([1, 9]))
         with pytest.raises(ValueError):
             sha256_rows(rows, np.array([-1, 4]))
+
+    def test_fallback_path_matches_native(self, monkeypatch):
+        """With the native library unavailable the hashlib fallback
+        must produce identical digests (it is the degraded path for
+        toolchain-less deployments)."""
+        import hashlib
+
+        import numpy as np
+
+        import pytest
+
+        from cleisthenes_tpu.ops import hashrows
+        from cleisthenes_tpu.native.build import load_sha256
+
+        if load_sha256() is None:
+            # without the toolchain "native" would BE the fallback and
+            # the comparison below would check it against itself
+            pytest.skip("native sha256 unavailable; nothing to compare")
+        rng = np.random.default_rng(9)
+        rows = rng.integers(0, 256, size=(13, 57), dtype=np.uint8)
+        lens = rng.integers(0, 58, size=13)
+        native = hashrows.sha256_rows(rows, lens)
+        monkeypatch.setattr(hashrows, "load_sha256", lambda: None)
+        degraded = hashrows.sha256_rows(rows, lens)
+        assert (native == degraded).all()
+        # independent hashlib checks for BOTH fallback branches
+        for i in (0, 7):
+            assert (
+                degraded[i].tobytes()
+                == hashlib.sha256(rows[i, : int(lens[i])].tobytes()).digest()
+            )
+        full = hashrows.sha256_rows(rows)
+        assert full[3].tobytes() == hashlib.sha256(rows[3].tobytes()).digest()
