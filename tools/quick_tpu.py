@@ -1,0 +1,77 @@
+"""Short-window TPU capture: the headline sections only.
+
+The relay's healthy windows can be shorter than a full bench.py run;
+this grabs the round-4 priority measurements (lockstep N=128 epoch —
+the north-star scale; lockstep N=512 — the decisive-vs-cpu scale;
+the crypto-plane metric; the wide-limb families) in ~6-10 minutes and
+writes TPU_QUICK_r04.json atomically.  The full-artifact capture
+(tools/bench_watcher.py -> BENCH_live_r04.json) remains the recorded
+bench; this is the evidence fallback for a dying window.
+
+Usage:  python tools/quick_tpu.py       (normal env, relay attached)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import bench  # noqa: E402
+
+
+def main() -> int:
+    import jax
+
+    dev = jax.devices()[0]
+    if dev.platform not in ("tpu", "axon"):
+        print(f"not a TPU: {dev}; aborting", file=sys.stderr)
+        return 1
+    out = {
+        "platform": dev.platform,
+        "device": getattr(dev, "device_kind", ""),
+        "start_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+
+    def stamp(name, fn):
+        t0 = time.perf_counter()
+        try:
+            out[name] = fn()
+        except Exception as exc:  # record, don't lose the window
+            out[name] = {"error": repr(exc)[:300]}
+        out[name + "_wall_s"] = round(time.perf_counter() - t0, 1)
+        print(f"[quick] {name} done @ {time.strftime('%H:%M:%S')}",
+              file=sys.stderr, flush=True)
+        _write(out)  # persist after EVERY section: windows die mid-run
+
+    def _write(doc):
+        tmp = os.path.join(REPO, "TPU_QUICK_r04.json.tmp")
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, os.path.join(REPO, "TPU_QUICK_r04.json"))
+
+    stamp(
+        "protocol_spmd_n128_tpu",
+        lambda: bench.measure_spmd("tpu", 128, 10_000, 3),
+    )
+    stamp(
+        "protocol_spmd_n512_tpu",
+        lambda: bench.measure_spmd("tpu", 512, 4096, 2),
+    )
+    stamp(
+        "epoch_crypto_p50_ms_tpu",
+        lambda: round(bench.measure_crypto("tpu") * 1000.0, 3),
+    )
+    stamp("modexp_wide", bench.measure_modexp_wide)
+    out["end_utc"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    _write(out)
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
