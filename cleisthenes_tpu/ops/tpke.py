@@ -904,6 +904,24 @@ class Ciphertext:
 
 
 def _keystream(key: bytes, length: int) -> bytes:
+    n_blocks = (length + 31) // 32
+    if n_blocks >= 16:
+        # batch-size payloads (tens of KB per proposer): hash every
+        # counter block in one native crossing — byte-identical to
+        # the scalar loop below
+        from cleisthenes_tpu.ops.hashrows import sha256_rows
+
+        k = len(key)
+        rows = np.empty((n_blocks, k + 6), dtype=np.uint8)
+        rows[:, :k] = np.frombuffer(key, dtype=np.uint8)
+        rows[:, k : k + 4] = (
+            np.arange(n_blocks, dtype=">u4")
+            .view(np.uint8)
+            .reshape(n_blocks, 4)
+        )
+        rows[:, k + 4] = ord("k")
+        rows[:, k + 5] = ord("s")
+        return sha256_rows(rows).tobytes()[:length]
     out = []
     ctr = 0
     while 32 * len(out) < length:
