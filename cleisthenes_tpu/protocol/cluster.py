@@ -78,6 +78,14 @@ class SimulatedCluster:
             if shared_hub
             else None
         )
+        # same rationale as dedup above: N in-proc nodes re-parse the
+        # identical decrypted blobs; per-node deployments keep it off
+        if shared_hub:
+            from cleisthenes_tpu.protocol.honeybadger import (
+                enable_tx_parse_memo,
+            )
+
+            enable_tx_parse_memo(True)
         self.nodes: Dict[str, HoneyBadger] = {}
         for nid in self.ids:
             hb = HoneyBadger(

@@ -28,6 +28,7 @@ deployment model (docs/THRESHOLD_ENCRYPTION-EN.md:33: "SetUp").
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import random
 import struct
 from typing import Callable, Dict, List, Optional, Sequence, Set
@@ -35,6 +36,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Set
 from cleisthenes_tpu.config import Config
 from cleisthenes_tpu.core.batch import Batch
 from cleisthenes_tpu.core.queue import TxQueue
+from cleisthenes_tpu.protocol.hub import _Memo
 from cleisthenes_tpu.ops import tpke as tpke_mod
 from cleisthenes_tpu.ops.backend import BatchCrypto, get_backend
 from cleisthenes_tpu.ops.coin import CommonCoin
@@ -89,7 +91,39 @@ def serialize_txs(txs: Sequence[bytes]) -> bytes:
     return b"".join(out)
 
 
+# Content-keyed parse memo: every node of an IN-PROC cluster decrypts
+# the SAME plaintext per proposer and re-parses it (N x N parses of N
+# distinct blobs per epoch; ~1.7 s at N=64/B=16k).  Keyed by digest —
+# blobs are distinct bytes objects per node, so id-keying cannot hit.
+# OFF by default: a real per-node deployment parses N distinct blobs
+# that never recur, so the memo would pin megabyte blobs and pay a
+# pure-overhead SHA-256 per parse (same reasoning — and the same
+# switch point — as CryptoHub's dedup flag; the cluster simulations
+# enable it).
+_TX_PARSE_MEMO: Optional["_Memo"] = None
+
+
+def enable_tx_parse_memo(on: bool) -> None:
+    """Cluster-simulation switch (SimulatedCluster turns it on)."""
+    global _TX_PARSE_MEMO
+    _TX_PARSE_MEMO = _Memo(1 << 10) if on else None
+
+
 def deserialize_txs(data: bytes) -> List[bytes]:
+    memo = _TX_PARSE_MEMO
+    if memo is not None and len(data) >= 256:
+        # small blobs: the digest costs about as much as the parse
+        key = hashlib.sha256(data).digest()
+        hit = memo.map.get(key)
+        if hit is not None:
+            return list(hit)
+        out = _deserialize_txs_uncached(data)
+        memo.put(key, tuple(out))
+        return out
+    return _deserialize_txs_uncached(data)
+
+
+def _deserialize_txs_uncached(data: bytes) -> List[bytes]:
     if len(data) < 4:
         raise ValueError("truncated tx list")
     (count,) = struct.unpack_from(">I", data, 0)
