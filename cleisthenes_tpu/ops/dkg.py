@@ -140,7 +140,14 @@ class DkgDealing:
         )
 
     def commitments(self, backend: str = "cpu", mesh=None) -> List[int]:
-        """Feldman commitments C_k = g^{a_k} — broadcast publicly."""
+        """Feldman commitments A_k = g^{a_k}.
+
+        Under the GJKR flow these are the PHASE-2 opening: they must
+        stay private until the qualified set Q is fixed — broadcasting
+        them alongside the phase-1 Pedersen commitments reopens the
+        Joint-Feldman rushing-bias channel the two-phase structure
+        exists to close.  (Standalone Feldman-VSS uses, e.g. the unit
+        tests, may broadcast them immediately.)"""
         gp = self.group
         eng = get_engine_degraded(backend, mesh, gp)
         return eng.pow_batch([gp.g] * len(self._coeffs), self._coeffs)

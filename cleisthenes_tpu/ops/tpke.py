@@ -593,9 +593,17 @@ def verify_and_combine_share_groups(
         by_gp.setdefault(pub.group, []).append(gi)
     co_gp: Optional[GroupParams] = None
     if combine_only_sets:
-        co_gp = combine_only_group or (
-            groups[0][0].group if groups else DEFAULT_GROUP
-        )
+        if combine_only_group is not None:
+            co_gp = combine_only_group
+        elif groups:
+            co_gp = groups[0][0].group
+        else:
+            # guessing a group here would produce a well-formed but
+            # cryptographically WRONG combination (and memoize it)
+            raise ValueError(
+                "combine_only_sets without groups requires an "
+                "explicit combine_only_group"
+            )
         by_gp.setdefault(co_gp, [])
     verdicts: Dict[int, List[bool]] = {}
     values: Dict[int, Optional[int]] = {}

@@ -79,13 +79,12 @@ class SimulatedCluster:
             else None
         )
         # same rationale as dedup above: N in-proc nodes re-parse the
-        # identical decrypted blobs; per-node deployments keep it off
-        if shared_hub:
-            from cleisthenes_tpu.protocol.honeybadger import (
-                enable_tx_parse_memo,
-            )
+        # identical decrypted blobs; per-node deployments pass None.
+        # Instance-scoped and shared across THIS cluster's nodes only
+        # (dies with the cluster — never process-global state).
+        from cleisthenes_tpu.protocol.honeybadger import make_tx_parse_memo
 
-            enable_tx_parse_memo(True)
+        tx_memo = make_tx_parse_memo() if shared_hub else None
         self.nodes: Dict[str, HoneyBadger] = {}
         for nid in self.ids:
             hb = HoneyBadger(
@@ -96,6 +95,7 @@ class SimulatedCluster:
                 out=ChannelBroadcaster(self.net, nid, self.ids),
                 auto_propose=auto_propose,
                 hub=hub,
+                tx_parse_memo=tx_memo,
             )
             self.nodes[nid] = hb
             self.net.join(

@@ -91,26 +91,24 @@ def serialize_txs(txs: Sequence[bytes]) -> bytes:
     return b"".join(out)
 
 
-# Content-keyed parse memo: every node of an IN-PROC cluster decrypts
-# the SAME plaintext per proposer and re-parses it (N x N parses of N
-# distinct blobs per epoch; ~1.7 s at N=64/B=16k).  Keyed by digest —
-# blobs are distinct bytes objects per node, so id-keying cannot hit.
-# OFF by default: a real per-node deployment parses N distinct blobs
-# that never recur, so the memo would pin megabyte blobs and pay a
-# pure-overhead SHA-256 per parse (same reasoning — and the same
-# switch point — as CryptoHub's dedup flag; the cluster simulations
-# enable it).
-_TX_PARSE_MEMO: Optional["_Memo"] = None
+def make_tx_parse_memo() -> _Memo:
+    """Content-keyed parse memo for CLUSTER SIMULATIONS: every in-proc
+    node decrypts the SAME plaintext per proposer and re-parses it
+    (N x N parses of N distinct blobs per epoch; ~1.7 s at
+    N=64/B=16k).  Keyed by digest — blobs are distinct bytes objects
+    per node, so id-keying cannot hit.  A real per-node deployment
+    parses N distinct blobs that never recur, so it passes NO memo
+    (the default): pinning megabyte blobs and hashing every parse
+    would be pure overhead there — same reasoning, and the same
+    seam, as CryptoHub's dedup flag.  Instance-scoped (the cluster
+    shares ONE across its nodes and drops it with the cluster), never
+    process-global."""
+    return _Memo(1 << 10)
 
 
-def enable_tx_parse_memo(on: bool) -> None:
-    """Cluster-simulation switch (SimulatedCluster turns it on)."""
-    global _TX_PARSE_MEMO
-    _TX_PARSE_MEMO = _Memo(1 << 10) if on else None
-
-
-def deserialize_txs(data: bytes) -> List[bytes]:
-    memo = _TX_PARSE_MEMO
+def deserialize_txs(
+    data: bytes, memo: Optional[_Memo] = None
+) -> List[bytes]:
     if memo is not None and len(data) >= 256:
         # small blobs: the digest costs about as much as the parse
         key = hashlib.sha256(data).digest()
@@ -341,8 +339,12 @@ class HoneyBadger:
         auto_propose: bool = True,
         batch_log=None,
         hub=None,
+        tx_parse_memo: Optional[_Memo] = None,
     ) -> None:
         self.config = config
+        # cluster simulations pass one shared make_tx_parse_memo()
+        # across all nodes; real deployments leave it None
+        self._tx_parse_memo = tx_parse_memo
         self.node_id = node_id
         self.members: List[str] = sorted(member_ids)
         self._member_set = frozenset(self.members)
@@ -812,7 +814,9 @@ class HoneyBadger:
                 self.hub.request_flush()
                 return
             try:
-                es.decrypted[proposer] = deserialize_txs(plain)
+                es.decrypted[proposer] = deserialize_txs(
+                    plain, self._tx_parse_memo
+                )
             except ValueError:
                 # authentic plaintext, malformed framing: the
                 # proposer's own doing, identical at every node
@@ -877,7 +881,9 @@ class HoneyBadger:
                     continue
                 try:
                     plain = self.tpke.combine(ct, valid)
-                    es.decrypted[proposer] = deserialize_txs(plain)
+                    es.decrypted[proposer] = deserialize_txs(
+                    plain, self._tx_parse_memo
+                )
                 except ValueError:
                     # combined KEM value is independent of the share
                     # subset, so a failed tag/framing fails identically

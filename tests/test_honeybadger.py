@@ -382,31 +382,28 @@ def test_n128_full_protocol_epoch():
 
 def test_tx_parse_memo_hit_and_cap():
     """The content-keyed deserialize_txs memo (cluster simulations
-    only): the hit path must return a fresh, equal LIST, and the cap
-    overflow must clear without corrupting results."""
+    only; instance-scoped, never global): the hit path must return a
+    fresh, equal LIST, and the cap overflow must clear without
+    corrupting results."""
     from cleisthenes_tpu.protocol import honeybadger as hb
 
-    old = hb._TX_PARSE_MEMO
-    try:
-        hb.enable_tx_parse_memo(True)
-        txs = [b"x" * 40 for _ in range(12)]  # blob >= 256 B
-        blob = hb.serialize_txs(txs)
-        first = hb.deserialize_txs(blob)
-        second = hb.deserialize_txs(bytes(blob))  # distinct object
-        assert first == second == txs
-        assert isinstance(second, list)
-        assert second is not first  # callers may mutate their copy
-        second.append(b"mutant")
-        assert hb.deserialize_txs(blob) == txs  # cache unpoisoned
-        # cap overflow clears wholesale and keeps parsing correctly
-        hb._TX_PARSE_MEMO.cap = 4
-        for i in range(10):
-            extra = hb.serialize_txs([b"y%02d" % i] + txs)
-            assert hb.deserialize_txs(extra)[0] == b"y%02d" % i
-        assert hb.deserialize_txs(blob) == txs
-        # memo off: parsing still works, nothing is cached
-        hb.enable_tx_parse_memo(False)
-        assert hb.deserialize_txs(blob) == txs
-        assert hb._TX_PARSE_MEMO is None
-    finally:
-        hb._TX_PARSE_MEMO = old
+    memo = hb.make_tx_parse_memo()
+    txs = [b"x" * 40 for _ in range(12)]  # blob >= 256 B
+    blob = hb.serialize_txs(txs)
+    first = hb.deserialize_txs(blob, memo)
+    second = hb.deserialize_txs(bytes(blob), memo)  # distinct object
+    assert first == second == txs
+    assert isinstance(second, list)
+    assert second is not first  # callers may mutate their copy
+    second.append(b"mutant")
+    assert hb.deserialize_txs(blob, memo) == txs  # cache unpoisoned
+    # cap overflow clears wholesale and keeps parsing correctly
+    memo.cap = 4
+    for i in range(10):
+        extra = hb.serialize_txs([b"y%02d" % i] + txs)
+        assert hb.deserialize_txs(extra, memo)[0] == b"y%02d" % i
+    assert hb.deserialize_txs(blob, memo) == txs
+    # no memo passed (real per-node deployments): nothing cached
+    before = len(memo.map)
+    assert hb.deserialize_txs(blob) == txs
+    assert len(memo.map) == before

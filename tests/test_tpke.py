@@ -227,13 +227,23 @@ class TestBatchedChallenge:
         gp = mm.DEFAULT_GROUP
         nb = gp.nbytes
         ctxs, bases, his, ds, a1s, a2s = [], [], [], [], [], []
-        for i in range(50):
+        # m=100 is ABOVE the m<64 scalar cutoff: this must exercise
+        # the numpy/native matrix path, not compare the scalar path
+        # with itself (a round-4 review caught exactly that vacuity)
+        m = 100
+        assert m >= 64
+        for i in range(m):
             # mixed context lengths exercise the group-by-length path
             ctxs.append(b"ctx|%d" % (10 ** (i % 4)))
             for lst in (bases, his, ds, a1s, a2s):
                 lst.append(int.from_bytes(_s.token_bytes(nb), "big") % gp.p)
         got = tpke._cp_challenge_batch(ctxs, bases, his, ds, a1s, a2s, gp)
-        for k in range(50):
+        # and the sub-cutoff scalar path agrees on a prefix slice
+        got_small = tpke._cp_challenge_batch(
+            ctxs[:8], bases[:8], his[:8], ds[:8], a1s[:8], a2s[:8], gp
+        )
+        assert got_small == got[:8]
+        for k in range(m):
             want = (
                 tpke._hash_to_int(
                     b"cp", ctxs[k],
