@@ -1,0 +1,136 @@
+"""Measurement mutual exclusion (tools/benchlock.py).
+
+Round-4 weak #2: concurrent watcher probes silently inflated the
+driver's CPU capture ~2x on this one-core box.  These tests pin the
+three behaviors that prevent a recurrence: exclusivity, reentrancy
+for spawned children, and pause/resume of registered background jobs.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from tools import benchlock
+
+
+@pytest.fixture(autouse=True)
+def _isolated_lock(tmp_path, monkeypatch):
+    monkeypatch.setattr(benchlock, "LOCK_PATH", str(tmp_path / "lock"))
+    monkeypatch.setattr(benchlock, "PAUSE_DIR", str(tmp_path / "pause"))
+    monkeypatch.delenv(benchlock._ENV_KEY, raising=False)
+
+
+def test_exclusive_second_holder_sees_busy():
+    with benchlock.hold("a") as held_a:
+        assert held_a
+        # a second would-be holder in THIS process is reentrant by
+        # design; exclusivity is cross-process, via a child
+        env = dict(os.environ)
+        env.pop(benchlock._ENV_KEY, None)
+        env["PYTHONPATH"] = os.path.dirname(os.path.dirname(__file__))
+        code = (
+            "from tools import benchlock\n"
+            f"benchlock.LOCK_PATH = {benchlock.LOCK_PATH!r}\n"
+            f"benchlock.PAUSE_DIR = {benchlock.PAUSE_DIR!r}\n"
+            "with benchlock.hold('b', block=False) as held:\n"
+            "    print('HELD' if held else 'BUSY')\n"
+        )
+        r = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, env=env, timeout=60,
+        )
+        assert "BUSY" in r.stdout, r.stdout + r.stderr
+    # released: the same child code now acquires
+    r = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True, env=env, timeout=60,
+    )
+    assert "HELD" in r.stdout, r.stdout + r.stderr
+
+
+def test_reentrant_for_children_via_env():
+    with benchlock.hold("outer") as a:
+        assert a
+        # simulates bench.py --child spawned by a lock-holding parent:
+        # the env marker is inherited, so the nested hold no-ops
+        assert os.environ.get(benchlock._ENV_KEY) == str(os.getpid())
+        with benchlock.hold("inner") as b:
+            assert b
+    assert benchlock._ENV_KEY not in os.environ
+
+
+def test_pausable_job_is_stopped_and_resumed():
+    child = subprocess.Popen(
+        [sys.executable, "-c", "import time\nwhile True: time.sleep(0.2)"],
+    )
+    try:
+        os.makedirs(benchlock.PAUSE_DIR, exist_ok=True)
+        with open(os.path.join(benchlock.PAUSE_DIR, str(child.pid)), "w"):
+            pass
+
+        def state() -> str:
+            with open(f"/proc/{child.pid}/stat") as f:
+                return f.read().split(")")[-1].split()[0]
+
+        with benchlock.hold("capture"):
+            deadline = time.time() + 10
+            while state() != "T" and time.time() < deadline:
+                time.sleep(0.05)
+            assert state() == "T"  # SIGSTOPped while the lock is held
+        deadline = time.time() + 10
+        while state() == "T" and time.time() < deadline:
+            time.sleep(0.05)
+        assert state() != "T"  # SIGCONTed on release
+    finally:
+        child.kill()
+        child.wait()
+
+
+def test_late_registration_self_stops_and_release_resumes():
+    """A job that registers while a capture is in flight must stop
+    itself immediately (the holder's pause snapshot cannot see it) and
+    wake at release via the holder's registry re-scan."""
+    code = (
+        "import sys\n"
+        "from tools import benchlock\n"
+        f"benchlock.LOCK_PATH = {benchlock.LOCK_PATH!r}\n"
+        f"benchlock.PAUSE_DIR = {benchlock.PAUSE_DIR!r}\n"
+        "benchlock.register_pausable()\n"
+        "print('RESUMED', flush=True)\n"
+    )
+    env = dict(os.environ)
+    env.pop(benchlock._ENV_KEY, None)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(__file__))
+    with benchlock.hold("capture"):
+        child = subprocess.Popen(
+            [sys.executable, "-c", code],
+            stdout=subprocess.PIPE, text=True, env=env,
+        )
+        # the child must reach its self-SIGSTOP, not print RESUMED
+        deadline = time.time() + 20
+        state = ""
+        while time.time() < deadline:
+            try:
+                with open(f"/proc/{child.pid}/stat") as f:
+                    state = f.read().split(")")[-1].split()[0]
+            except OSError:
+                break
+            if state == "T":
+                break
+            time.sleep(0.05)
+        assert state == "T", f"child never self-stopped (state={state})"
+    out, _ = child.communicate(timeout=20)
+    assert "RESUMED" in out  # release re-scan CONTed it
+
+
+def test_load_snapshot_shape():
+    snap = benchlock.load_snapshot()
+    assert len(snap["loadavg"]) == 3
+    assert isinstance(snap["competing_python_procs"], int)
+    assert isinstance(snap["paused_jobs"], int)

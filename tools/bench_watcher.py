@@ -1,9 +1,9 @@
-"""Relay watcher: re-capture BENCH_live_r04.json when the TPU returns.
+"""Relay watcher: re-capture BENCH_live_r05.json when the TPU returns.
 
 The axon relay dies and revives unpredictably (TPU_EVIDENCE_r03.md);
 this loop probes it on a long interval and, on a healthy window, runs
 the full bench and ATOMICALLY replaces the live artifact — only when
-the run really executed on the TPU (platform == "tpu"), so a relay
+the run really executed on the TPU (platform 'tpu' or 'axon'), so a relay
 that dies mid-run can never overwrite good evidence with a fallback
 (that exact accident cost one capture this round; the artifact now
 moves via os.replace from a tempfile, never a shell truncation).
@@ -15,12 +15,16 @@ from __future__ import annotations
 
 import json
 import os
+import signal
 import subprocess
 import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-ARTIFACT = os.path.join(REPO, "BENCH_live_r04.json")
+sys.path.insert(0, REPO)
+from tools import benchlock  # noqa: E402
+
+ARTIFACT = os.path.join(REPO, "BENCH_live_r05.json")
 PROBE_INTERVAL_S = 300
 PROBE_TIMEOUT_S = 45
 BENCH_TIMEOUT_S = 3600
@@ -48,27 +52,41 @@ def probe() -> bool:
     return r.returncode == 0 and "PROBE_OK" in r.stdout
 
 
-def capture() -> bool:
+def capture() -> "str | None":
+    """Returns the captured platform string, or None on failure."""
     tmp = ARTIFACT + ".tmp"
     try:
         with open(tmp, "w") as out:
-            r = subprocess.run(
+            # own session: a timeout must kill the whole process GROUP
+            # (bench.py + its --child grandchild), not just bench.py —
+            # an orphaned child would burn the core invisibly after
+            # the lock releases
+            proc = subprocess.Popen(
                 [sys.executable, "bench.py"],
                 stdout=out,
                 stderr=subprocess.DEVNULL,
-                timeout=BENCH_TIMEOUT_S,
                 cwd=REPO,
+                start_new_session=True,
             )
-        if r.returncode != 0:
-            return False
+            try:
+                rc = proc.wait(timeout=BENCH_TIMEOUT_S)
+            except subprocess.TimeoutExpired:
+                try:
+                    os.killpg(proc.pid, signal.SIGKILL)
+                except OSError:
+                    pass
+                proc.wait()
+                return None
+        if rc != 0:
+            return None
         with open(tmp) as f:
             doc = json.loads(f.readline())
-        if doc.get("platform") != "tpu":
-            return False  # fallback run: never clobber TPU evidence
+        if doc.get("platform") not in ("tpu", "axon"):
+            return None  # fallback run: never clobber TPU evidence
         os.replace(tmp, ARTIFACT)
-        return True
-    except (subprocess.TimeoutExpired, json.JSONDecodeError, OSError):
-        return False
+        return str(doc.get("platform"))
+    except (json.JSONDecodeError, OSError):
+        return None
     finally:
         # every non-replace exit (timeout, bad rc, fallback, crash,
         # KeyboardInterrupt) must clean the tempfile up
@@ -81,15 +99,24 @@ def capture() -> bool:
 
 def main() -> None:
     while True:
-        if probe():
-            print(time.strftime("%H:%M:%S"), "relay healthy; capturing",
-                  flush=True)
-            if capture():
+        # the lock covers the PROBE too: round 4 proved that even the
+        # 5-min jax-import probes contaminate a concurrent capture on
+        # this one-core box.  Busy lock -> skip the whole cycle.
+        with benchlock.hold("bench_watcher", block=False) as held:
+            if not held:
+                time.sleep(PROBE_INTERVAL_S)
+                continue
+            if probe():
+                print(time.strftime("%H:%M:%S"), "relay healthy; capturing",
+                      flush=True)
+                platform = capture()
+                if platform is not None:
+                    print(time.strftime("%H:%M:%S"),
+                          f"captured platform={platform} artifact; exiting",
+                          flush=True)
+                    return
                 print(time.strftime("%H:%M:%S"),
-                      "captured platform=tpu artifact; exiting", flush=True)
-                return
-            print(time.strftime("%H:%M:%S"),
-                  "capture did not yield a tpu artifact", flush=True)
+                      "capture did not yield a TPU-side artifact", flush=True)
         time.sleep(PROBE_INTERVAL_S)
 
 

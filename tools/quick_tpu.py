@@ -1,11 +1,11 @@
 """Short-window TPU capture: the headline sections only.
 
 The relay's healthy windows can be shorter than a full bench.py run;
-this grabs the round-4 priority measurements (lockstep N=128 epoch —
+this grabs the round-5 priority measurements (lockstep N=128 epoch —
 the north-star scale; lockstep N=512 — the decisive-vs-cpu scale;
 the crypto-plane metric; the wide-limb families) in ~6-10 minutes and
-writes TPU_QUICK_r04.json atomically.  The full-artifact capture
-(tools/bench_watcher.py -> BENCH_live_r04.json) remains the recorded
+writes TPU_QUICK_r05.json atomically.  The full-artifact capture
+(tools/bench_watcher.py -> BENCH_live_r05.json) remains the recorded
 bench; this is the evidence fallback for a dying window.
 
 Usage:  python tools/quick_tpu.py       (normal env, relay attached)
@@ -22,9 +22,15 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 import bench  # noqa: E402
+from tools import benchlock  # noqa: E402
 
 
 def main() -> int:
+    with benchlock.hold("quick_tpu"):
+        return _main_locked()
+
+
+def _main_locked() -> int:
     import jax
 
     dev = jax.devices()[0]
@@ -49,10 +55,10 @@ def main() -> int:
         _write(out)  # persist after EVERY section: windows die mid-run
 
     def _write(doc):
-        tmp = os.path.join(REPO, "TPU_QUICK_r04.json.tmp")
+        tmp = os.path.join(REPO, "TPU_QUICK_r05.json.tmp")
         with open(tmp, "w") as f:
             json.dump(doc, f)
-        os.replace(tmp, os.path.join(REPO, "TPU_QUICK_r04.json"))
+        os.replace(tmp, os.path.join(REPO, "TPU_QUICK_r05.json"))
 
     stamp(
         "protocol_spmd_n128_tpu",

@@ -31,6 +31,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from tests.test_byzantine import make_hb_network, push_txs  # noqa: E402
 from cleisthenes_tpu.utils.adversary import Coalition  # noqa: E402
+from tools import benchlock  # noqa: E402
+
+# hours-long low-priority job: a bench capture seizing a TPU window
+# SIGSTOPs us for its duration instead of sharing the one core
+benchlock.register_pausable()
 
 MAX_ROUNDS = int(os.environ.get("SWEEP_MAX_ROUNDS", "40"))
 
