@@ -284,7 +284,9 @@ def measure_protocol(backend: str, n: int, batch: int, epochs: int) -> dict:
     }
 
 
-def measure_spmd(backend: str, n: int, batch: int, epochs: int) -> dict:
+def measure_spmd(
+    backend: str, n: int, batch: int, epochs: int, group=None
+) -> dict:
     """Full-protocol lockstep epochs (protocol.spmd.LockstepCluster):
     every epoch performs the complete deduplicated cryptographic work
     of an N-validator HBBFT epoch — real RS/Merkle/branch-verify, real
@@ -294,7 +296,11 @@ def measure_spmd(backend: str, n: int, batch: int, epochs: int) -> dict:
     from cleisthenes_tpu.protocol.spmd import LockstepCluster
 
     cluster = LockstepCluster(
-        n=n, batch_size=batch, crypto_backend=backend, key_seed=77
+        n=n,
+        batch_size=batch,
+        crypto_backend=backend,
+        key_seed=77,
+        group=group,
     )
     rng = np.random.default_rng(13)
     total = (batch // n) * n * (epochs + 1)
@@ -342,9 +348,20 @@ _MODP14 = int(
 )
 
 
+# RFC 2409 First Oakley Group (768-bit safe prime) — sized for the
+# (11, 72) limb family, so all three wide families get a measured
+# device-vs-host number (WIDE_FLOORS provenance)
+_OAKLEY1 = int(
+    "FFFFFFFFFFFFFFFFC90FDAA22168C234C4C6628B80DC1CD129024E088A67CC74"
+    "020BBEA63B139B22514A08798E3404DDEF9519B3CD3A431B302B0A6DF25F1437"
+    "4FE1356D6D51C245E485B576625E7EC6F44C42E9A63A3620FFFFFFFFFFFFFFFF",
+    16,
+)
+
+
 def measure_modexp_wide() -> dict:
-    """exps/s of the wide XLA limb families (384-bit and 2048-bit
-    groups) vs the host comparator — python pow here, since the native
+    """exps/s of the wide XLA limb families (384/768/2048-bit groups)
+    vs the host comparator — python pow here, since the native
     Montgomery kernel is 256-bit-only (round-3 verdict item 4: these
     widths used to be REJECTED by the XLA engine)."""
     from cleisthenes_tpu.ops import modmath as mm
@@ -353,10 +370,16 @@ def measure_modexp_wide() -> dict:
     out = {}
     for label, p, batch in (
         ("384", mm.P384, 2048),  # the packaged 384-bit group's prime
+        ("768", _OAKLEY1, 512),  # (11,72) family
         ("2048", _MODP14, 128),
     ):
         group = mm.GroupParams(p=p, q=(p - 1) // 2, g=4)
-        eng = mm.get_engine("tpu", group=group)
+        # uncached engine (get_engine's per-group cache would leak the
+        # pin below into protocol sections), device-pinned: WIDE_FLOORS
+        # would route the 2048-bit batch (measured 0.97x host) back to
+        # the host and this section would measure pow against pow
+        eng = mm.ModEngine("tpu", group=group)
+        eng.host_delegation = False
         bases = [
             int.from_bytes(rng.bytes(group.nbytes), "big") % p
             for _ in range(batch)
@@ -463,27 +486,39 @@ def measure_n512_pipelined(backend: str) -> dict:
     n_share_checks = 2 * n * (f + 1)
     engine_backend = "cpu" if backend == "cpp" else backend
 
-    def stage_a():
-        """Epoch RBC plane: encode + forest + branch wave + decode."""
-        encoded = crypto.erasure.encode_batch(data)
-        trees = crypto.merkle.build_batch(encoded)
-        roots = np.stack(
-            [np.frombuffer(t.root, dtype=np.uint8) for t in trees]
-        )
-        leaves = encoded[:, 0, :]
-        depth = trees[0].depth
-        branches = np.stack(
-            [np.stack([np.frombuffer(s, dtype=np.uint8) for s in t.branch(0)])
-             for t in trees]
-        ).reshape(n, depth, 32)
-        ok = crypto.merkle.verify_batch(
-            roots, leaves, branches, np.zeros(n, dtype=np.int64)
-        )
-        assert bool(ok.all())
-        survivor = np.arange(shards - k, shards)
-        crypto.erasure.decode_batch(
-            np.tile(survivor, (n, 1)), encoded[:, survivor, :]
-        )
+    def make_stage_a(c):
+        """Epoch RBC plane: encode + forest + branch wave + decode —
+        one body, instantiated per crypto backend so the sequential
+        reference and the pipelined run measure identical work."""
+
+        def stage_a():
+            encoded = c.erasure.encode_batch(data)
+            trees = c.merkle.build_batch(encoded)
+            roots = np.stack(
+                [np.frombuffer(t.root, dtype=np.uint8) for t in trees]
+            )
+            leaves = encoded[:, 0, :]
+            depth = trees[0].depth
+            branches = np.stack(
+                [
+                    np.stack(
+                        [np.frombuffer(s, dtype=np.uint8) for s in t.branch(0)]
+                    )
+                    for t in trees
+                ]
+            ).reshape(n, depth, 32)
+            ok = c.merkle.verify_batch(
+                roots, leaves, branches, np.zeros(n, dtype=np.int64)
+            )
+            assert bool(ok.all())
+            survivor = np.arange(shards - k, shards)
+            c.erasure.decode_batch(
+                np.tile(survivor, (n, 1)), encoded[:, survivor, :]
+            )
+
+        return stage_a
+
+    stage_a = make_stage_a(crypto)
 
     def stage_b():
         """Epoch share-verify plane (decrypt + coin verification)."""
@@ -497,20 +532,46 @@ def measure_n512_pipelined(backend: str) -> dict:
             assert all(res)
             remaining -= chunk
 
-    stage_a()
-    stage_b()  # warm-up / compile
+    # On the TPU backend, round-3 measured the two-device-wave
+    # pipeline at 0.6x (both stages feed ONE dispatch queue over the
+    # relay — interleaving them from two threads just reorders the
+    # same serialized queue, plus thread overhead).  The overlap that
+    # CAN win pairs different execution units: epoch e+1's RBC plane
+    # on the HOST's native kernels while epoch e's share-verify plane
+    # drains on the device (r4 verdict item 7).
+    stage_a_host = None
+    if backend == "tpu":
+        stage_a_host = make_stage_a(
+            BatchCrypto(
+                cpu_reference_backend(), shards, (shards - k) // 2, k
+            )
+        )
+
+    # warm-up / compile: only the stage-A variant the timed loops use
+    if stage_a_host is not None:
+        stage_a_host()
+    else:
+        stage_a()
+    stage_b()
+    # the pipelined run's stage-A placement; the SEQUENTIAL REFERENCE
+    # uses the same placement, so pipeline_overlap_x isolates overlap
+    # and cannot be inflated by the host plane merely being faster
+    # than the device plane (code-review finding)
+    pipe_a = stage_a_host if stage_a_host is not None else stage_a
     # sequential reference: epochs strictly one after another
     t0 = time.perf_counter()
     for _ in range(P512_EPOCHS):
-        stage_a()
+        pipe_a()
         stage_b()
     seq_wall = time.perf_counter() - t0
-    # two-deep pipeline: e+1's RBC plane overlaps e's share verify
+    # two-deep pipeline: e+1's RBC plane overlaps e's share verify;
+    # on tpu the RBC plane runs on the host's native kernels so the
+    # overlapped units are genuinely different (host cores vs device)
     with concurrent.futures.ThreadPoolExecutor(1) as pool:
         t0 = time.perf_counter()
         tail = None
         for _ in range(P512_EPOCHS):
-            stage_a()
+            pipe_a()
             if tail is not None:
                 tail.result()
             tail = pool.submit(stage_b)
@@ -529,6 +590,13 @@ def measure_n512_pipelined(backend: str) -> dict:
         "pipeline_overlap_x": round(seq_wall / pipe_wall, 3)
         if pipe_wall > 0
         else None,
+        # which unit ran the overlapped RBC plane: on tpu it is the
+        # HOST's native kernels (device-on-device overlap measured
+        # 0.6x in r3 — one dispatch queue), so overlap > 1 means the
+        # host plane genuinely hid under the device's verify drain
+        "pipelined_stage_a": (
+            "host-native" if stage_a_host is not None else backend
+        ),
         "share_checks_per_epoch": n_share_checks,
     }
 
@@ -576,12 +644,33 @@ def run_child() -> None:
         # watcher probe silently inflated every CPU section ~2x)
         "host_load_start": _load_snapshot(),
     }
+    # Per-section persistence: a child killed by the parent's timeout
+    # (or a dying relay window) keeps every section it finished — the
+    # parent salvages this file instead of discarding a 50-min run
+    # (which is exactly what happened to the first round-5 capture).
+    out: dict = {"partial": True, "provenance": provenance}
+
+    def persist() -> None:
+        try:
+            tmp = _PARTIAL_PATH + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(out, f)
+            os.replace(tmp, _PARTIAL_PATH)
+        except OSError:
+            pass
+
+    _progress_plain = progress
+
+    def progress(section: str) -> None:  # noqa: F811 — wrap: persist too
+        persist()
+        _progress_plain(section)
+
     cpu_ref = cpu_reference_backend()
     progress(f"platform={platform} ({device_kind}); crypto_n128 tpu")
     accel_p50 = measure_crypto("tpu")
     progress("crypto_n128 cpu")
     cpu_p50 = measure_crypto(cpu_ref)
-    out = {
+    out.update({
         "metric": "epoch_crypto_p50_n128_f42_b10k",
         "value": round(accel_p50 * 1000.0, 3),
         "unit": "ms",
@@ -593,7 +682,7 @@ def run_child() -> None:
             "CPU GF plane uses native C++ kernels when available; "
             + modexp_comparator_note()
         ),
-    }
+    })
     for name, pc in PROTO_CONFIGS.items():
         progress(name)
         if on_tpu:
@@ -639,6 +728,39 @@ def run_child() -> None:
             else None
         ),
     }
+    if on_tpu:
+        # The flagship roster under a production-width group (round-4
+        # verdict item 5): the SAME full lockstep protocol — TPKE,
+        # coin, RS, Merkle — with every exponentiation in the 384-bit
+        # safe-prime group (BLS12-381 base-field width class, (12,32)
+        # XLA limb family) instead of the 256-bit research group.  The
+        # CPU comparator is python pow at this width (native kernel is
+        # 256-only), measured at 1 epoch to bound its cost.
+        from cleisthenes_tpu.ops.modmath import GROUP384
+
+        progress("protocol_spmd_n128_g384 tpu")
+        g384_tpu = measure_spmd("tpu", 128, 10_000, 2, group=GROUP384)
+        progress("protocol_spmd_n128_g384 cpu")
+        g384_cpu = measure_spmd(
+            cpu_ref, 128, 10_000, 1, group=GROUP384
+        )
+        out["protocol_spmd_n128_g384"] = {
+            "n": 128, "f": 42, "batch": 10_000,
+            "group_bits": 384,
+            "mode": "lockstep, GROUP384 end-to-end (TPKE + coin); "
+                    "cpu modexp comparator is python pow",
+            "tpu": g384_tpu,
+            "cpu": g384_cpu,
+            "vs_cpu": _vs(
+                g384_cpu["epoch_p50_ms"], g384_tpu["epoch_p50_ms"]
+            ),
+            # the price of width on the SAME backend (vs the 256-bit
+            # flagship section above)
+            "g384_over_g256_tpu": _vs(
+                g384_tpu["epoch_p50_ms"],
+                spmd_tpu["epoch_p50_ms"] if spmd_tpu else None,
+            ),
+        }
     if on_tpu:
         # BASELINE config 5 as a TRUE full-protocol run: N=512
         # validators through RBC + BBA + TPKE in lockstep, on the
@@ -703,10 +825,17 @@ def run_child() -> None:
     provenance["dispatch_ms_end"] = dispatch_ms()
     provenance["host_load_end"] = _load_snapshot()
     out["provenance"] = provenance
+    del out["partial"]  # completed run: not a salvage artifact
+    persist()
     print(json.dumps(out))
 
 
 CHILD_TIMEOUT_S = int(os.environ.get("BENCH_CHILD_TIMEOUT_S", "3000"))
+# where the child persists completed sections (parent salvages on
+# timeout; a finished run overwrites it with the final artifact)
+_PARTIAL_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "BENCH_PARTIAL.json"
+)
 
 
 def _spawn_child(force_cpu: bool) -> "tuple[dict | None, str]":
@@ -717,6 +846,7 @@ def _spawn_child(force_cpu: bool) -> "tuple[dict | None, str]":
         # relay is never touched; the XLA path then runs on host CPU
         env.pop("PALLAS_AXON_POOL_IPS", None)
         env["JAX_PLATFORMS"] = "cpu"
+    t_start = time.time()
     try:
         r = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--child"],
@@ -726,6 +856,13 @@ def _spawn_child(force_cpu: bool) -> "tuple[dict | None, str]":
             env=env,
         )
     except subprocess.TimeoutExpired:
+        doc = _salvage_partial(
+            t_start,
+            f"child timed out after {CHILD_TIMEOUT_S}s; completed "
+            "sections salvaged from the child's per-section persistence",
+        )
+        if doc is not None:
+            return doc, ""
         return None, f"timeout after {CHILD_TIMEOUT_S}s"
     for line in reversed(r.stdout.strip().splitlines()):
         try:
@@ -735,7 +872,35 @@ def _spawn_child(force_cpu: bool) -> "tuple[dict | None, str]":
         except json.JSONDecodeError:
             continue
     tail = (r.stderr or r.stdout or "").strip().splitlines()
-    return None, f"rc={r.returncode}: {' | '.join(tail[-3:]) or 'no output'}"
+    detail = f"rc={r.returncode}: {' | '.join(tail[-3:]) or 'no output'}"
+    # a child that CRASHED mid-run (relay death aborting the process,
+    # not just outliving the cap) also keeps its persisted sections
+    doc = _salvage_partial(
+        t_start,
+        f"child died before finishing ({detail}); completed sections "
+        "salvaged from the child's per-section persistence",
+    )
+    if doc is not None:
+        return doc, ""
+    return None, detail
+
+
+def _salvage_partial(t_start: float, note: str) -> "dict | None":
+    """The child persists every completed section to _PARTIAL_PATH; a
+    run that dies (timeout OR crash) must not collapse a 50-min TPU
+    capture into a CPU fallback (round-5 capture #1 was lost exactly
+    this way)."""
+    try:
+        if os.path.getmtime(_PARTIAL_PATH) < t_start:
+            return None  # stale: from some earlier run
+        with open(_PARTIAL_PATH) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    if isinstance(doc, dict) and doc.get("metric"):
+        doc["note"] = note
+        return doc
+    return None
 
 
 def _probe_relay(timeout_s: int = 90) -> bool:

@@ -478,6 +478,7 @@ def run_dkg(
     corrupt_dealers: Sequence[int] = (),
     false_accusers: Sequence[int] = (),
     phase2_cheaters: Sequence[int] = (),
+    phase2_short_openers: Sequence[int] = (),
 ) -> Tuple[ThresholdPublicKey, List[ThresholdSecretShare], List[int]]:
     """Drive the whole GJKR protocol in-process (the test/simulation
     harness; a deployment pumps the same steps over RBC broadcasts and
@@ -492,7 +493,11 @@ def run_dkg(
     - ``phase2_cheaters`` deal honestly in phase one but broadcast
       garbage Feldman openings in phase two — their contribution must
       be reconstructed, leaving the final key exactly what phase one
-      fixed (the rushing-adversary regression).
+      fixed (the rushing-adversary regression);
+    - ``phase2_short_openers`` broadcast a WRONG-LENGTH opening
+      (t-1 entries) — the length guard must shunt them to the same
+      reconstruction path instead of desynchronizing the batched
+      exponent layouts (advisor r4 finding).
 
     Returns (pub, shares, qualified_dealer_indices)."""
     dealings = {
@@ -556,12 +561,18 @@ def run_dkg(
     reveal_ok = verify_pedersen_shares(
         reveal_items, group=group, backend=backend, mesh=mesh
     )
+    # (receiver, dealer) pairs proven consistent with the dealer's
+    # phase-one Pedersen commitments — the ONLY shares phase two may
+    # later interpolate from (a receiver lying about its share must
+    # not be able to poison a reconstruction)
+    ped_verified = {(j, i) for (j, i), ok in zip(order, verdicts) if ok}
     disqualified = set(bad_commits)
     for (j, i), item, ok in zip(reveal_order, reveal_items, reveal_ok):
         if ok:
             # valid reveal: the complaint was slander (or transport
             # corruption); receiver j adopts the now-public pair
             pairs[j][i] = item[2:4]
+            ped_verified.add((j, i))
         else:
             disqualified.add(i)
     qualified = sorted(set(range(1, n + 1)) - disqualified)
@@ -575,14 +586,25 @@ def run_dkg(
     # -- phase two: Feldman opening, reconstruct cheaters -------------
     feld = {}
     for i in qualified:
-        if i in phase2_cheaters:
+        if i in phase2_short_openers:
+            # wrong-length opening: parses element-wise but must be
+            # caught by the length guard before any batch flattening
+            feld[i] = [group.g] * (threshold - 1)
+        elif i in phase2_cheaters:
             # garbage opening: right length, valid subgroup elements,
             # wrong values — the strongest cheat that still parses
             feld[i] = [group.g] * threshold
         else:
             feld[i] = dealings[i].commitments(backend=backend, mesh=mesh)
+    # length guard BEFORE anything is flattened: a t' != t opening
+    # from a real adversary would desynchronize the batched exponent
+    # layouts below (see verify_dealer_shares' docstring); such a
+    # dealer goes straight to the reconstruction path, mirroring
+    # finalize's own guard
+    wrong_len = {i for i in qualified if len(feld[i]) != threshold}
+    p2_checked = [i for i in qualified if i not in wrong_len]
     feld_ok = validate_commitments(
-        [feld[i] for i in qualified],
+        [feld[i] for i in p2_checked],
         group=group,
         backend=backend,
         mesh=mesh,
@@ -591,16 +613,18 @@ def run_dkg(
     # consistency vs the phase-one shares every receiver holds
     p2_items = []
     p2_order = []
-    for i in qualified:
+    for i in p2_checked:
         for j in range(1, n + 1):
             p2_items.append((feld[i], j, pairs[j][i][0]))
             p2_order.append((i, j))
     p2_verdicts = verify_dealer_shares(
         p2_items, group=group, backend=backend, mesh=mesh
     )
-    bad_openings = {
-        i for i, ok in zip(qualified, feld_ok) if not ok
-    } | {i for (i, j), ok in zip(p2_order, p2_verdicts) if not ok}
+    bad_openings = (
+        wrong_len
+        | {i for i, ok in zip(p2_checked, feld_ok) if not ok}
+        | {i for (i, j), ok in zip(p2_order, p2_verdicts) if not ok}
+    )
     if bad_openings:
         # NOT disqualified: their secrets are already in x.
         # Reconstruct each f_i from t phase-one-verified shares and
@@ -609,9 +633,20 @@ def run_dkg(
         recon = sorted(bad_openings)
         all_coeffs: List[int] = []
         for i in recon:
-            pts = [(j, pairs[j][i][0]) for j in range(1, n + 1)][
-                :threshold
-            ]
+            # interpolate ONLY from shares proven against dealer i's
+            # phase-one Pedersen commitments: a Byzantine receiver
+            # among the first t broadcasting a lie must not yield a
+            # wrong opening that splits honest nodes' keys
+            pts = [
+                (j, pairs[j][i][0])
+                for j in range(1, n + 1)
+                if (j, i) in ped_verified
+            ][:threshold]
+            if len(pts) < threshold:
+                raise RuntimeError(
+                    f"dealer {i}: only {len(pts)} Pedersen-verified "
+                    f"shares < t={threshold} for reconstruction"
+                )
             all_coeffs.extend(_interpolate_coeffs(pts, group.q))
         pows = eng.pow_batch(
             [group.g] * len(all_coeffs), all_coeffs

@@ -375,8 +375,11 @@ class BBA:
         self.broadcast_coin_share(self.round, share)
 
     def broadcast_coin_share(self, rnd: int, share) -> None:
-        if self.halted:
-            return
+        # deliberately NOT gated on halted: the share is a deterministic
+        # public VUF value, and a node that decides via TERM between
+        # queueing a coin issue and draining it must still contribute —
+        # slower peers may be one share short of the coin threshold
+        # (advisor r4 finding on the deferred-issue drain)
         self.out.broadcast(
             CoinPayload(
                 proposer=self.proposer,

@@ -546,8 +546,10 @@ class HoneyBadger:
         items = []
         metas = []
         for bba, rnd in pend:
-            if bba.halted:
-                continue
+            # halted BBAs still contribute: the issue was queued when
+            # the aux quorum fired, and withholding the (public,
+            # deterministic) share after a TERM decision can leave
+            # slower peers one share short of the coin threshold
             _pub, base, context = bba.coin.group_params(bba._coin_id(rnd))
             items.append((sec, base, context, vks[sec.index - 1]))
             metas.append((bba, rnd))
@@ -882,8 +884,8 @@ class HoneyBadger:
                 try:
                     plain = self.tpke.combine(ct, valid)
                     es.decrypted[proposer] = deserialize_txs(
-                    plain, self._tx_parse_memo
-                )
+                        plain, self._tx_parse_memo
+                    )
                 except ValueError:
                     # combined KEM value is independent of the share
                     # subset, so a failed tag/framing fails identically

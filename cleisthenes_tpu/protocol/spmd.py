@@ -87,6 +87,7 @@ class LockstepCluster:
         key_seed: int = 1,
         member_ids: Optional[Sequence[str]] = None,
         group=None,
+        coin_block_doubling: bool = True,
     ) -> None:
         if config is not None:
             if n != 4 and n != config.n:
@@ -116,6 +117,11 @@ class LockstepCluster:
         # b = max(B, n): the reference's batch floor
         # (honeybadger.go:62-104 via protocol.honeybadger)
         self.b = max(cfg.batch_size, cfg.n)
+        # doubling coin-round blocks amortize relay RTT; block=1 is
+        # the serial comparator for the on-chip A/B (r4 verdict weak
+        # #3: speculation's win has to be MEASURED against the relay,
+        # not assumed)
+        self.coin_block_doubling = coin_block_doubling
         self.last_stats: Dict[str, float] = {}
 
     # -- application surface ----------------------------------------------
@@ -323,11 +329,13 @@ class LockstepCluster:
 
         next_rnd = 0
         block = 1
+        coin_waves = 0
         while undecided and next_rnd < MAX_COIN_ROUNDS:
             rnds = range(
                 next_rnd, min(next_rnd + block, MAX_COIN_ROUNDS)
             )
             run_rounds(rnds, undecided, dec=fuse_dec and next_rnd == 0)
+            coin_waves += 1
             for rnd in rnds:
                 rounds_used = rnd + 1
                 undecided = [
@@ -338,13 +346,15 @@ class LockstepCluster:
                 if not undecided:
                     break
             next_rnd = rnds.stop
-            block = block * 2 if next_rnd > 1 else 1
+            if self.coin_block_doubling:
+                block = block * 2 if next_rnd > 1 else 1
         if undecided:
             raise AssertionError(
                 f"instances undecided after {MAX_COIN_ROUNDS} rounds"
             )
         stats["bba_s"] = time.perf_counter() - t0
         stats["bba_rounds"] = rounds_used
+        stats["coin_waves"] = coin_waves
         stats["coin_issues"] = coin_issues
         stats["coin_verifies"] = coin_verifies
         # attribution note: with dec_fused=1 the decrypt wave's device

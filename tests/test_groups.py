@@ -101,13 +101,24 @@ def test_2048bit_modp14_cpu_only():
 # class, (12, 32) XLA limb family) — see ops/modmath.GROUP384.
 from cleisthenes_tpu.ops.modmath import GROUP384, P384  # noqa: E402
 
-# Batches must clear ModEngine.HOST_FLOOR_NO_NATIVE (16): smaller
-# batches silently reroute to the host engine and the "device" test
-# compares python pow against python pow (round-4 review finding).
+# The measured per-family floors (ModEngine.WIDE_FLOORS) delegate
+# small wide-group batches to the host — so device-path correctness
+# tests pin the device kernels with host_delegation=False (the
+# class-level test escape; round-4 review found the earlier version
+# comparing python pow against python pow).
 WIDE_BATCH = 24
 
 
-def test_384bit_group_xla_engine_matches_pow(jax_cpu_devices):
+@pytest.fixture
+def device_pinned(monkeypatch):
+    from cleisthenes_tpu.ops.modmath import ModEngine
+
+    monkeypatch.setattr(ModEngine, "host_delegation", False)
+
+
+def test_384bit_group_xla_engine_matches_pow(
+    jax_cpu_devices, device_pinned
+):
     """The wide XLA limb family (SURVEY §7 hard part 1: a group sized
     for BLS12-381's base field on the device path, replacing round-3's
     256-bit rejection)."""
@@ -129,13 +140,15 @@ def test_384bit_group_xla_engine_matches_pow(jax_cpu_devices):
     ]
 
 
-def test_384bit_group_full_protocol_xla(jax_cpu_devices):
+def test_384bit_group_full_protocol_xla(jax_cpu_devices, device_pinned):
     """The whole TPKE + coin round-trip under the 384-bit group on the
     XLA engine — the seam swap the module docstrings promise."""
     _roundtrip(GROUP384, "tpu")
 
 
-def test_2048bit_modp14_xla_engine_matches_pow(jax_cpu_devices):
+def test_2048bit_modp14_xla_engine_matches_pow(
+    jax_cpu_devices, device_pinned
+):
     """Round-3 verdict item: the 2048-bit MODP-14 group runs on the
     TPU path (11x192-limb family), property-matched against python
     pow.  Replaces test_xla_engine_rejects_oversized_group."""
@@ -156,6 +169,20 @@ def test_2048bit_modp14_xla_engine_matches_pow(jax_cpu_devices):
         pow(a, x, GROUP14.p) * pow(b, y, GROUP14.p) % GROUP14.p
         for a, x, b, y in zip(bases[:h], exps[:h], bases[h:], exps[h:])
     ]
+
+
+def test_wide_floors_route_by_measured_crossover(jax_cpu_devices):
+    """Round-4 verdict weak #4: engine defaults must follow measured
+    device-vs-host crossovers per limb family (TPU_QUICK_r05
+    modexp_wide).  384-bit wins on device above ~160 exps (floor 256);
+    2048-bit measured 0.97x host — it must ALWAYS delegate."""
+    eng384 = get_engine("tpu", group=GROUP384)
+    assert eng384._host_floor(255) is not None  # below floor -> host
+    assert eng384._host_floor(256) is None  # above -> device
+    eng2048 = get_engine("tpu", group=GROUP14)
+    for b in (8, 256, 1 << 16):
+        host = eng2048._host_floor(b)
+        assert host is not None and host.backend == "cpu"
 
 
 def test_xla_engine_still_rejects_beyond_every_family():

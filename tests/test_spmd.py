@@ -104,3 +104,23 @@ def test_lockstep_roster_past_gf256_ceiling():
     got = _committed_txs(c.committed())
     assert got == {_tx(i) for i in range(257)}
     assert c.crypto.erasure.MAX_N == 1 << 16
+
+
+def test_lockstep_serial_coin_blocks_match_doubling():
+    """The coin_block_doubling knob (the on-chip A/B comparator,
+    AB_COIN_BLOCKS_r05) changes dispatch batching only: committed
+    transactions, coin values, and round counts are identical because
+    the shares are deterministic VUFs of (epoch, proposer, round)."""
+    a = LockstepCluster(n=5, batch_size=40, key_seed=9)
+    b = LockstepCluster(
+        n=5, batch_size=40, key_seed=9, coin_block_doubling=False
+    )
+    for i in range(80):
+        a.submit(_tx(i))
+        b.submit(_tx(i))
+    a.run_epochs()
+    b.run_epochs()
+    assert _committed_txs(a.committed()) == _committed_txs(b.committed())
+    assert a.last_stats["bba_rounds"] == b.last_stats["bba_rounds"]
+    # serial runs one wave per round; doubling compresses the tail
+    assert b.last_stats["coin_waves"] == b.last_stats["bba_rounds"]

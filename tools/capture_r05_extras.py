@@ -1,0 +1,119 @@
+"""Round-5 supplementary chip capture: the sections added AFTER the
+main bench launched — GROUP384 flagship, host-overlap pipelining,
+the 768-bit limb family — written to TPU_EXTRAS_r05.json with
+per-section persistence (windows die mid-run).
+
+Usage:  python tools/capture_r05_extras.py [sections...]
+        (default: all of g384 pipelined modexp)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import bench  # noqa: E402
+from tools import benchlock  # noqa: E402
+
+OUT = os.path.join(REPO, "TPU_EXTRAS_r05.json")
+
+
+def main() -> int:
+    wanted = set(sys.argv[1:]) or {"g384", "pipelined", "modexp"}
+    with benchlock.hold("capture_r05_extras"):
+        return _run(wanted)
+
+
+def _run(wanted) -> int:
+    import jax
+
+    dev = jax.devices()[0]
+    if dev.platform not in ("tpu", "axon"):
+        print(f"not a TPU: {dev}; aborting", file=sys.stderr)
+        return 1
+    out = {}
+    if os.path.exists(OUT):
+        with open(OUT) as f:
+            out = json.load(f)
+    out.update(
+        {
+            "platform": dev.platform,
+            "device": getattr(dev, "device_kind", ""),
+            "start_utc": time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+            ),
+            "host_load": os.getloadavg(),
+        }
+    )
+
+    def _write():
+        tmp = OUT + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(out, f)
+        os.replace(tmp, OUT)
+
+    def stamp(name, fn):
+        t0 = time.perf_counter()
+        try:
+            out[name] = fn()
+        except Exception as exc:  # record, don't lose the window
+            out[name] = {"error": repr(exc)[:300]}
+        out[name + "_wall_s"] = round(time.perf_counter() - t0, 1)
+        _write()
+        print(f"[extras] {name} done @ {time.strftime('%H:%M:%S')}",
+              file=sys.stderr, flush=True)
+
+    if "g384" in wanted:
+        from cleisthenes_tpu.ops.modmath import GROUP384
+
+        def g384():
+            tpu = bench.measure_spmd(
+                "tpu", 128, 10_000, 2, group=GROUP384
+            )
+            cpu = bench.measure_spmd(
+                bench.cpu_reference_backend(),
+                128,
+                10_000,
+                1,
+                group=GROUP384,
+            )
+            return {
+                "n": 128, "f": 42, "batch": 10_000, "group_bits": 384,
+                "tpu": tpu,
+                "cpu": cpu,
+                "vs_cpu": bench._vs(
+                    cpu["epoch_p50_ms"], tpu["epoch_p50_ms"]
+                ),
+            }
+
+        stamp("protocol_spmd_n128_g384", g384)
+    if "pipelined" in wanted:
+        def pipelined():
+            tpu = bench.measure_n512_pipelined("tpu")
+            cpu = bench.measure_n512_pipelined(
+                bench.cpu_reference_backend()
+            )
+            return {
+                "tpu": tpu,
+                "cpu": cpu,
+                "vs_cpu": bench._vs(
+                    cpu["epoch_p50_ms"], tpu["epoch_p50_ms"]
+                ),
+            }
+
+        stamp("crypto_n512_pipelined_hostoverlap", pipelined)
+    if "modexp" in wanted:
+        stamp("modexp_wide", bench.measure_modexp_wide)
+    out["end_utc"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    _write()
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

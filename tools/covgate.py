@@ -59,17 +59,54 @@ def _executable_lines() -> dict:
     return out
 
 
-def pytest_sessionstart(session):
-    sys.monitoring.use_tool_id(_TOOL, "covgate")
+# Registration happens at plugin-import time, NOT pytest_sessionstart:
+# pytest imports -p plugins before conftest.py, so module-level lines
+# executed during conftest/plugin-triggered imports are counted too.
+# Registering in sessionstart deflated coverage by whatever the
+# conftest import graph touched first (advisor r4 finding).
+_armed = False
+
+
+def _arm() -> None:
+    global _armed
+    if _armed:
+        return
+    try:
+        sys.monitoring.use_tool_id(_TOOL, "covgate")
+    except ValueError:
+        # COVERAGE_ID held by another tool (e.g. coverage.py's sysmon
+        # core): stay unarmed and leave THEIR registration alone —
+        # sessionfinish must not free an id we never acquired
+        return
+    _armed = True
     sys.monitoring.register_callback(
         _TOOL, sys.monitoring.events.LINE, _on_line
     )
     sys.monitoring.set_events(_TOOL, sys.monitoring.events.LINE)
 
 
+_arm()
+
+
+def pytest_sessionstart(session):
+    _arm()  # idempotent; covers exotic plugin-manager import orders
+
+
 def pytest_sessionfinish(session, exitstatus):
+    global _armed
+    if not _armed:
+        # COVERAGE_ID was held by another tool for the whole session:
+        # nothing was measured, so gating on the empty _covered dict
+        # would fail the suite with a misleading 0% — report the
+        # conflict and skip the gate instead
+        print(
+            "covgate: DISARMED (sys.monitoring COVERAGE_ID held by "
+            "another tool); coverage not measured, gate skipped"
+        )
+        return
     sys.monitoring.set_events(_TOOL, 0)
     sys.monitoring.free_tool_id(_TOOL)
+    _armed = False
     want = _executable_lines()
     total = sum(len(v) for v in want.values())
     hit = sum(
