@@ -712,7 +712,7 @@ class SharePool:
     """
 
     __slots__ = ("threshold", "_pending", "_verified", "_burned",
-                 "_seen", "_lazy", "_n")
+                 "_seen", "_lazy", "_n", "_idx_cover")
 
     def __init__(self, threshold: int):
         self.threshold = threshold
@@ -728,6 +728,15 @@ class SharePool:
         # structured access, so arrival cost is probe+append
         self._lazy: List[tuple] = []
         self._n = 0  # pending+verified+lazy (burns decrement)
+        # distinct Shamir indices held (pending+verified+lazy) — an
+        # upper bound on achievable interpolation coverage, letting
+        # lazy row-store pulls stop the moment the threshold is
+        # coverable instead of materializing a whole wave (recomputed
+        # exactly when a burn invalidates it)
+        self._idx_cover: set = set()
+
+    def covered(self) -> int:
+        return len(self._idx_cover)
 
     def add(self, sender: str, share: DhShare) -> bool:
         """First share per non-burned sender wins."""
@@ -735,6 +744,7 @@ class SharePool:
             return False
         self._seen.add(sender)
         self._pending[sender] = share
+        self._idx_cover.add(share.index)
         self._n += 1
         return True
 
@@ -747,6 +757,7 @@ class SharePool:
             return False
         self._seen.add(sender)
         self._lazy.append((sender, index, d, e, z))
+        self._idx_cover.add(index)
         self._n += 1
         return True
 
@@ -804,6 +815,7 @@ class SharePool:
     def apply_verdicts(self, senders: Sequence[str], ok: Sequence[bool]) -> None:
         """Record external verification verdicts: valid shares move to
         the verified set, senders of invalid ones burn."""
+        burned_any = False
         for sender, good in zip(senders, ok):
             share = self._pending.pop(sender, None)
             if share is None:
@@ -813,6 +825,15 @@ class SharePool:
             else:
                 self._burned.add(sender)
                 self._n -= 1
+                burned_any = True
+        if burned_any:
+            # the burned share may have been an index's only holder:
+            # recompute the coverage bound exactly (rare path)
+            self._idx_cover = {
+                s.index for s in self._pending.values()
+            } | {s.index for s in self._verified.values()} | {
+                row[1] for row in self._lazy
+            }
 
     def ready(self) -> Optional[List[DhShare]]:
         """>= threshold index-distinct verified shares, or None."""

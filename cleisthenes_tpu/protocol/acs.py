@@ -22,6 +22,8 @@ from __future__ import annotations
 
 from typing import Callable, Dict, List, Optional, Sequence, Set
 
+import numpy as np
+
 from cleisthenes_tpu.config import Config
 from cleisthenes_tpu.ops.backend import BatchCrypto
 from cleisthenes_tpu.ops.coin import CommonCoin
@@ -34,6 +36,167 @@ from cleisthenes_tpu.transport.message import (
     CoinPayload,
     RbcPayload,
 )
+
+
+class CoinRowStore:
+    """Round-keyed columnar coin-share rows for one epoch's N BBAs.
+
+    The round-5 profile showed the per-share coin ingestion chain
+    (batch handler -> per-instance dispatch -> pool add, ~573k scalar
+    calls per N=64 epoch) as the largest protocol cost after echoes.
+    This store replaces it with ROW semantics: one sender's whole
+    share fan-out (a CoinBatchPayload, or a width-1 single) is ONE
+    append here, and per-instance pools materialize shares lazily —
+    bounded to the f+1 the threshold needs on the fast path, and
+    completely at every hub-flush boundary, where pools therefore
+    hold exactly what the eager path would have held (the burn/
+    replacement/verdict logic is untouched).
+
+    Pools are NOT fully materialized at flush time: BBA._top_up_coin
+    pulls only until the threshold is index-coverable, and surplus
+    rows stay parked here; the burn/replacement logic re-pulls on the
+    re-marked flush round, and the per-instance ``watch`` re-notifies
+    when a replayed index leaves a threshold-size pool under-covered.
+
+    DoS bounds: rounds are capped at bba.MAX_ROUNDS (bounding the
+    by_round table); per-sender FRESH rows are capped per round at
+    2n (an honest sender emits at most one share per instance per
+    round — n width-1 singles in the worst schedule); replayed frames
+    are fresh-filtered before any cap or count is touched; and
+    per-instance dedup stays in SharePool (first share per sender
+    wins), so a Byzantine sender still only ever burns its own slot.
+    """
+
+    __slots__ = (
+        "members",
+        "threshold",
+        "_iidx",
+        "by_round",
+        "_col_memo",
+        "_watch_rnd",
+    )
+    _COL_MEMO_CAP = 4096
+    MAX_COIN_ROW_ROUNDS = 256
+
+    def __init__(self, members: Sequence[str], threshold: int) -> None:
+        self.members = list(members)
+        self.threshold = threshold
+        self._iidx = {p: i for i, p in enumerate(self.members)}
+        # rnd -> [rows, counts, notified, (sender,inst) seen,
+        #         per-sender fresh-row counts]
+        self.by_round: Dict[int, list] = {}
+        # id(proposers) -> (proposers, {proposer: column}, idx array) —
+        # the codec payload memo shares one proposers tuple across a
+        # broadcast's receivers, so these build once per wire payload
+        # (width-1 singles bypass the memo entirely: each single is a
+        # fresh tuple that could never hit and would churn the table)
+        self._col_memo: dict = {}
+        # per-instance watched ROUND (-1 = off): re-notify arrivals
+        # for exactly the round whose pool is threshold-size but
+        # index-under-covered — the coin analog of the round-4
+        # dec-share crossing-stall fix.  Round-scoped, so a watch can
+        # never burn a DIFFERENT round's one-shot crossing flag.
+        self._watch_rnd = np.full(len(self.members), -1, dtype=np.int64)
+
+    def watch_on(self, proposer_index: int, rnd: int) -> None:
+        self._watch_rnd[proposer_index] = rnd
+
+    def watch_off(self, proposer_index: int) -> None:
+        self._watch_rnd[proposer_index] = -1
+
+    def add(
+        self, sender: str, rnd: int, index: int, proposers, d, e, z
+    ) -> list:
+        """Append one sender row; returns the member names whose
+        DISTINCT-SENDER share count just crossed the threshold (fires
+        at most once per (round, instance)) plus any round-watched
+        instances the row contains.
+
+        Counting must be per (sender, instance) — exactly the dedup
+        SharePool applies — or a replayed/duplicated frame inflates a
+        count past the threshold with too few distinct senders, burns
+        the one-shot crossing, and the real quorum later arrives
+        unannounced (liveness stall found by the n=7 coalition test)."""
+        n = len(self.members)
+        if not (1 <= index <= n):
+            return []  # a bad Shamir index must not inflate counts
+        if not (0 <= rnd < self.MAX_COIN_ROW_ROUNDS):
+            return []  # bounds the by_round table (DoS): ~4KB+n^2
+            # bits of state per allocated round, and a coin decides
+            # each round w.p. 1/2 — P(honest round >= 256) ~ 2^-256
+        si = self._iidx.get(sender)
+        if si is None:
+            return []
+        ent = self.by_round.get(rnd)
+        if ent is None:
+            ent = self.by_round[rnd] = [
+                [],
+                np.zeros(n, dtype=np.int32),
+                np.zeros(n, dtype=bool),
+                np.zeros((n, n), dtype=bool),  # (sender, inst) seen
+                {},  # sender -> fresh rows this round
+            ]
+        rows, counts, notified, seen, sender_rows = ent
+        if len(proposers) == 1:
+            ci = self._iidx.get(proposers[0])
+            idx = (
+                np.asarray([ci], dtype=np.int64)
+                if ci is not None
+                else np.empty(0, dtype=np.int64)
+            )
+        else:
+            idx = self._memo(proposers)[2]
+        fresh = idx[~seen[si, idx]]
+        if fresh.size == 0:
+            return []  # pure replay: consumes no cap, changes nothing
+        # freshness-gated per-round cap: an honest sender emits at
+        # most one share per instance per round, i.e. <= n fresh rows
+        # even in the all-singles worst schedule
+        nrows = sender_rows.get(sender, 0)
+        if nrows >= 2 * n:
+            return []
+        sender_rows[sender] = nrows + 1
+        rows.append((sender, index, proposers, d, e, z))
+        seen[si, fresh] = True
+        counts[fresh] += 1
+        after = counts[fresh]
+        crossed_thr = fresh[(after >= self.threshold) & ~notified[fresh]]
+        notified[crossed_thr] = True  # the one-shot flag: thresholds only
+        watched = fresh[self._watch_rnd[fresh] == rnd]
+        if crossed_thr.size == 0 and watched.size == 0:
+            return []
+        members = self.members
+        out = [members[i] for i in crossed_thr]
+        for i in watched:
+            if i not in crossed_thr:
+                out.append(members[i])
+        return out
+
+    def count(self, rnd: int, proposer_index: int) -> int:
+        ent = self.by_round.get(rnd)
+        return int(ent[1][proposer_index]) if ent is not None else 0
+
+    def _memo(self, proposers):
+        ent = self._col_memo.get(id(proposers))
+        if ent is None or ent[0] is not proposers:
+            m = {p: i for i, p in enumerate(proposers)}
+            iidx = self._iidx
+            idx = np.asarray(
+                [iidx[p] for p in proposers if p in iidx],
+                dtype=np.int64,
+            )
+            if len(self._col_memo) >= self._COL_MEMO_CAP:
+                self._col_memo.clear()
+            ent = (proposers, m, idx)
+            self._col_memo[id(proposers)] = ent
+        return ent
+
+    def col(self, proposers, me: str):
+        """Column of ``me`` in a row's proposers tuple (id-memoized;
+        width-1 rows bypass the memo — see __init__)."""
+        if len(proposers) == 1:
+            return 0 if proposers[0] == me else None
+        return self._memo(proposers)[1].get(me)
 
 
 class ACS:
@@ -58,6 +221,7 @@ class ACS:
         self.epoch = epoch
         self.owner = owner
         self.members: List[str] = sorted(member_ids)
+        self._member_set = frozenset(self.members)
         # fn(epoch, {proposer: value}) fired exactly once
         self.on_output: Optional[Callable[[int, Dict[str, bytes]], None]] = None
 
@@ -108,6 +272,12 @@ class ACS:
         self._input_given: Set[str] = set()  # BBAs we provided input to
         self._zero_phase = False  # n-f ones seen, 0s injected
         self._output: Optional[Dict[str, bytes]] = None
+        # columnar coin ingestion: every coin share (batch or single)
+        # lands here as a row; BBAs pull lazily (see CoinRowStore)
+        self._coin_rows = CoinRowStore(self.members, coin.pub.threshold)
+        self._coin_threshold = coin.pub.threshold
+        for bba in self.bbas.values():
+            bba.coin_rows = self._coin_rows
 
     # -- public API --------------------------------------------------------
 
@@ -130,8 +300,32 @@ class ACS:
             return
         if isinstance(payload, RbcPayload):
             self.rbcs[proposer].handle_message(sender, payload)
-        elif isinstance(payload, (BbaPayload, CoinPayload)):
+        elif isinstance(payload, CoinPayload):
+            # width-1 row: singles and batches share ONE ingestion
+            # path, so threshold crossing is purely row-count based
+            if sender in self._member_set:
+                self._coin_row(
+                    sender,
+                    payload.round,
+                    payload.index,
+                    (proposer,),
+                    (payload.d,),
+                    (payload.e,),
+                    (payload.z,),
+                )
+        elif isinstance(payload, BbaPayload):
             self.bbas[proposer].handle_message(sender, payload)
+
+    def _coin_row(
+        self, sender: str, rnd: int, index: int, proposers, d, e, z
+    ) -> None:
+        crossed = self._coin_rows.add(
+            sender, rnd, index, proposers, d, e, z
+        )
+        for proposer in crossed:
+            bba = self.bbas.get(proposer)
+            if bba is not None and not bba.halted and bba.round == rnd:
+                bba.on_coin_rows(rnd)
 
     # -- columnar wave payloads (transport.message batch kinds) ------------
 
@@ -152,25 +346,15 @@ class ACS:
         )
 
     def handle_coin_batch(self, sender: str, p) -> None:
-        """One sender's coin shares fanned across instances: the
-        roster-membership check hoists out of the loop (handle_coin
-        re-checks per call; at N=64 the per-share frozenset probe and
-        the halted re-check were ~5% of an epoch).
-
-        A vectorized bank-row pre-filter (drop post-reveal/stale rows
-        in numpy before the Python loop) was tried and measured NO
-        BETTER (within this box's noise): ~8 small-array numpy ops
-        per batch roughly cancel the ~2.5 us scalar early-returns
-        they avoid at this batch width."""
+        """One sender's coin shares fanned across instances: ONE row
+        append in the CoinRowStore — per-instance pools pull lazily
+        (replacing the per-share dispatch chain the round-5 profile
+        put at ~573k scalar calls per N=64 epoch)."""
         if sender not in self.bank.sidx:
             return
-        bbas = self.bbas
-        rnd, index = p.round, p.index
-        d, e, z = p.d, p.e, p.z
-        for i, proposer in enumerate(p.proposers):
-            bba = bbas.get(proposer)
-            if bba is not None and not bba.halted:
-                bba.handle_coin_fast(sender, rnd, index, d[i], e[i], z[i])
+        self._coin_row(
+            sender, p.round, p.index, p.proposers, p.d, p.e, p.z
+        )
 
     def handle_ready_batch(self, sender: str, p) -> None:
         rbcs = self.rbcs
@@ -178,6 +362,23 @@ class ACS:
             rbc = rbcs.get(proposer)
             if rbc is not None:
                 rbc.handle_ready_root(sender, p.roots[i])
+
+    def handle_echo_batch(self, sender: str, p) -> None:
+        """One sender's ECHOes fanned across instances
+        (EchoBatchPayload): the membership gate hoists out of the
+        loop; the per-instance delivered gate stays inside (RBC
+        instances complete independently)."""
+        rbcs = self.rbcs
+        if sender not in self._member_set:
+            return
+        roots, branches, shards = p.roots, p.branches, p.shards
+        sidx = p.shard_index
+        for i, proposer in enumerate(p.proposers):
+            rbc = rbcs.get(proposer)
+            if rbc is not None and not rbc.delivered:
+                rbc.handle_echo_fast(
+                    sender, roots[i], branches[i], shards[i], sidx
+                )
 
     # -- composition rules (img/acs.png) -----------------------------------
 

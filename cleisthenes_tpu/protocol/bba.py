@@ -75,6 +75,7 @@ class _Round:
         "coin_shares",
         "coin_value",
         "advanced",
+        "rows_pulled",
     )
 
     def __init__(self, coin_threshold: int) -> None:
@@ -87,6 +88,9 @@ class _Round:
         self.coin_shares = SharePool(coin_threshold)
         self.coin_value: Optional[bool] = None
         self.advanced = False
+        # cursor into the ACS CoinRowStore's row list for this round
+        # (lazy columnar ingestion; see acs.CoinRowStore)
+        self.rows_pulled = 0
 
 
 class BBA:
@@ -149,6 +153,10 @@ class BBA:
         self.on_decide: Optional[Callable[[str, bool], None]] = None
 
         self._coin_threshold = coin.pub.threshold
+        # set by ACS after construction: the epoch's shared columnar
+        # coin-row store (None in standalone/unit-test use, where the
+        # scalar per-share path below carries everything)
+        self.coin_rows = None
         self._rounds: Dict[int, _Round] = {0: _Round(coin.pub.threshold)}
         self._term_sent = False
         self._term_recv: Dict[bool, Set[str]] = {True: set(), False: set()}
@@ -418,9 +426,77 @@ class BBA:
         r = self._cur()
         if r.coin_value is not None:
             return
+        self._top_up_coin(r)
         if len(r.coin_shares) < self.coin.pub.threshold:
             return
         self.hub.request_flush()
+
+    # -- columnar coin rows (acs.CoinRowStore) -----------------------------
+
+    def _pull_coin_rows(self, rnd: int, r: "_Round", target: int) -> None:
+        """Materialize this instance's shares from the ACS row store
+        into the round's pool, up to ``target`` pool entries — the
+        callers (_top_up_coin) pull only until the threshold is
+        index-coverable; surplus rows stay parked in the store and
+        never materialize."""
+        store = self.coin_rows
+        if store is None:
+            return
+        ent = store.by_round.get(rnd)
+        if ent is None:
+            return
+        rows = ent[0]
+        cur = r.rows_pulled
+        if cur >= len(rows):
+            return
+        pool = r.coin_shares
+        me = self.proposer
+        col_of = store.col
+        while cur < len(rows) and len(pool) < target:
+            sender, index, proposers, d, e, z = rows[cur]
+            cur += 1
+            ci = col_of(proposers, me)
+            if ci is not None:
+                pool.add_lazy(sender, index, d[ci], e[ci], z[ci])
+        r.rows_pulled = cur
+
+    def _top_up_coin(self, r: "_Round") -> None:
+        """Pull from the row store until the threshold is COVERABLE
+        (distinct Shamir indices) or the store has no more rows for
+        this round; arm the store's re-notify watch when a replayed
+        index leaves a threshold-size pool under-covered (the coin
+        analog of the round-4 dec-share crossing-stall fix)."""
+        pool = r.coin_shares
+        while pool.covered() < pool.threshold:
+            before = len(pool)
+            self._pull_coin_rows(
+                self.round,
+                r,
+                before + (pool.threshold - pool.covered()),
+            )
+            if len(pool) == before:
+                break  # store exhausted for this round
+        store = self.coin_rows
+        if store is not None and self.index is not None:
+            if pool.covered() < pool.threshold:
+                store.watch_on(self.index, self.round)
+            else:
+                store.watch_off(self.index)
+
+    def on_coin_rows(self, rnd: int) -> None:
+        """ACS notification: the store's round-``rnd`` rows reached
+        the coin threshold for this instance (or this instance just
+        entered a round whose rows already had, or it is watched and
+        a fresh row arrived)."""
+        if self.halted or rnd != self.round:
+            return
+        r = self._rounds.get(rnd)
+        if r is None or r.coin_value is not None:
+            return
+        self._top_up_coin(r)
+        if len(r.coin_shares) >= self._coin_threshold:
+            self.hub.mark_dirty(self)
+            self.hub.request_flush()
 
     # -- hub client protocol (protocol.hub.CryptoHub) ----------------------
 
@@ -430,9 +506,14 @@ class BBA:
         r = self._rounds.get(self.round)
         if r is None or r.coin_value is not None:
             return
-        senders, shs = r.coin_shares.collect_pending(
-            r.coin_shares.need_more()
-        )
+        # flush boundary: top the pool up until the threshold is
+        # COVERABLE (distinct Shamir indices), not until the store is
+        # empty — surplus rows stay parked and never materialize
+        # (burns recompute coverage, so deficits re-pull here on the
+        # re-marked flush round)
+        self._top_up_coin(r)
+        pool = r.coin_shares
+        senders, shs = pool.collect_pending(pool.need_more())
         if not senders:
             return
         pub, base, context = self.coin.group_params(
@@ -477,6 +558,8 @@ class BBA:
         if valid is None:
             return
         r.coin_value = self.coin.toss(self._coin_id(self.round), valid)
+        if self.coin_rows is not None and self.index is not None:
+            self.coin_rows.watch_off(self.index)
         self._maybe_advance()
 
     # -- round transition --------------------------------------------------
@@ -504,6 +587,15 @@ class BBA:
         self._rounds[self.round] = _Round(self.coin.pub.threshold)
         self.bank.reset_row(self.index, self.round)
         self._broadcast_bval(self.round, next_est)
+        # late entry: the store may already hold a coin quorum for the
+        # new round (its crossing notification fired before we got
+        # here and skipped us — round mismatch); any watch armed for
+        # the finished round is stale now
+        store = self.coin_rows
+        if store is not None and self.index is not None:
+            store.watch_off(self.index)
+            if store.count(self.round, self.index) >= self._coin_threshold:
+                self.on_coin_rows(self.round)
         # GC old round, replay parked messages for the new one
         self._rounds.pop(self.round - 1, None)
         replay_round = self.round
@@ -552,6 +644,8 @@ class BBA:
             self._rounds.clear()
             self._future.clear()
             self.bank.deactivate(self.index)
+            if self.coin_rows is not None and self.index is not None:
+                self.coin_rows.watch_off(self.index)
 
 
 __all__ = ["BBA", "ROUND_HORIZON", "MAX_ROUNDS"]

@@ -61,6 +61,7 @@ from cleisthenes_tpu.transport.message import (
     CoinPayload,
     DecShareBatchPayload,
     DecSharePayload,
+    EchoBatchPayload,
     Message,
     RbcPayload,
     ReadyBatchPayload,
@@ -620,6 +621,7 @@ class HoneyBadger:
                 BbaBatchPayload,
                 CoinBatchPayload,
                 ReadyBatchPayload,
+                EchoBatchPayload,
             ),
         ):
             # follow the epoch: a peer is running it, so contribute our
@@ -636,6 +638,8 @@ class HoneyBadger:
                 es.acs.handle_bba_batch(sender_id, payload)
             elif cls is CoinBatchPayload:
                 es.acs.handle_coin_batch(sender_id, payload)
+            elif cls is EchoBatchPayload:
+                es.acs.handle_echo_batch(sender_id, payload)
             elif cls is ReadyBatchPayload:
                 es.acs.handle_ready_batch(sender_id, payload)
             else:

@@ -120,8 +120,9 @@ class RBC:
         while p < self.n:
             p <<= 1
             self._depth += 1
-        # root -> sender -> payload awaiting batched branch verification
-        self._pending_echo: Dict[bytes, Dict[str, RbcPayload]] = {}
+        # root -> sender -> (branch, shard, shard_index) awaiting
+        # batched branch verification
+        self._pending_echo: Dict[bytes, Dict[str, tuple]] = {}
         # root -> set of verified ECHO senders
         self._echo_senders: Dict[bytes, Set[str]] = {}
         # root -> shard_index -> shard bytes (branch-verified)
@@ -190,6 +191,16 @@ class RBC:
     # -- handlers ----------------------------------------------------------
 
     def _precheck(self, payload: RbcPayload) -> bool:
+        return self._precheck_fields(
+            payload.root_hash,
+            payload.branch,
+            payload.shard,
+            payload.shard_index,
+        )
+
+    def _precheck_fields(
+        self, root: bytes, branch: tuple, shard: bytes, shard_index: int
+    ) -> bool:
         """Structural validation — everything except the branch hash
         check itself (reference rbc/rbc.go:93-95 `validateMessage`
         minus the crypto, which the hub batches).
@@ -199,13 +210,12 @@ class RBC:
         receivers, so the per-sibling length walk runs once per wire
         payload, not once per delivery (the held tuple pins the id);
         the remaining checks are a handful of scalar compares."""
-        if not (0 <= payload.shard_index < self.n):
+        if not (0 <= shard_index < self.n):
             return False
-        if not (0 < len(payload.shard) <= MAX_SHARD_BYTES):
+        if not (0 < len(shard) <= MAX_SHARD_BYTES):
             return False
-        if len(payload.root_hash) != 32:
+        if len(root) != 32:
             return False
-        branch = payload.branch
         if len(branch) != self._depth:
             return False
         ent = _BRANCH_SHAPE_MEMO.get(id(branch))
@@ -223,8 +233,8 @@ class RBC:
         # _handle_val after _check_proof and in _make_echo_cb), so an
         # unverified Byzantine ECHO cannot poison the expectation and
         # wedge honest traffic (ADVICE.md round-2 high finding).
-        want_len = self._shard_len.get(payload.root_hash)
-        if want_len is not None and len(payload.shard) != want_len:
+        want_len = self._shard_len.get(root)
+        if want_len is not None and len(shard) != want_len:
             return False
         return True
 
@@ -270,19 +280,41 @@ class RBC:
         )
 
     def _handle_echo(self, sender: str, payload: RbcPayload) -> None:
-        """docs/RBC-EN.md:35-39 (reference rbc/rbc.go:60-62).
+        self.handle_echo_fast(
+            sender,
+            payload.root_hash,
+            payload.branch,
+            payload.shard,
+            payload.shard_index,
+        )
 
-        The branch proof is NOT verified here: the payload parks in
-        the pending pool and verifies in the hub's next batched
-        dispatch — triggered below the moment this root could reach
-        its N-f quorum."""
-        root = payload.root_hash
+    def handle_echo_fast(
+        self,
+        sender: str,
+        root: bytes,
+        branch: tuple,
+        shard: bytes,
+        shard_index: int,
+    ) -> None:
+        """docs/RBC-EN.md:35-39 (reference rbc/rbc.go:60-62) — the
+        field-level entry the columnar EchoBatchPayload path calls
+        once per instance, skipping payload-object dispatch.
+
+        The branch proof is NOT verified here: the echo parks in the
+        pending pool and verifies in the hub's next batched dispatch —
+        triggered below the moment this root could reach its N-f
+        quorum.  Callers on the batch path must have checked
+        delivered/membership (ACS.handle_echo_batch hoists both)."""
         if sender in self._echo_voted:  # one ECHO per sender
             return
-        if not self._precheck(payload):
+        if not self._precheck_fields(root, branch, shard, shard_index):
             return
         self._echo_voted.add(sender)  # slot claimed; burns if invalid
-        self._pending_echo.setdefault(root, {})[sender] = payload
+        self._pending_echo.setdefault(root, {})[sender] = (
+            branch,
+            shard,
+            shard_index,
+        )
         self.hub.mark_dirty(self)
         if (
             self._echo_potential(root) >= self.n - self.f
@@ -379,15 +411,15 @@ class RBC:
         # dict and defeat the fast path above)
         for root in list(self._pending_echo):
             items = self._pending_echo.pop(root)
-            for sender, p in items.items():
+            for sender, (branch, shard, sidx) in items.items():
                 branches.append(
                     (
-                        p.root_hash,
-                        p.shard,
-                        tuple(p.branch),
-                        p.shard_index,
+                        root,
+                        shard,
+                        branch,
+                        sidx,
                         self,
-                        (root, sender, p),
+                        (root, sender, shard, sidx),
                     )
                 )
         # staged decode requests with enough verified shards
@@ -408,25 +440,25 @@ class RBC:
     def on_branch_verdicts(self, ctxs, oks) -> None:
         """Bulk ECHO-branch verdicts from the hub (one call per flush
         instead of a per-echo closure — at N=64 the closures alone
-        were ~1.8 s of an epoch).  ctx = (root, sender, payload)."""
+        were ~1.8 s of an epoch).  ctx = (root, sender, shard, sidx)."""
         if self.delivered:
             return
         shard_len = self._shard_len
         echo_senders = self._echo_senders
         shards = self._shards
         re_mark = False
-        for (root, sender, p), ok in zip(ctxs, oks):
+        for (root, sender, shard, sidx), ok in zip(ctxs, oks):
             if not ok:
                 continue  # invalid: the sender's one slot stays burned
             # length authority comes only from verified shards; a
             # verified shard conflicting with the established length
             # is a Byzantine proposer mixing lengths under one tree —
             # drop it, RS needs a rectangular matrix
-            want = shard_len.setdefault(root, len(p.shard))
-            if len(p.shard) != want:
+            want = shard_len.setdefault(root, len(shard))
+            if len(shard) != want:
                 continue
             echo_senders.setdefault(root, set()).add(sender)
-            shards.setdefault(root, {})[p.shard_index] = p.shard
+            shards.setdefault(root, {})[sidx] = shard
             re_mark = True
         # a staged decode may just have reached k shards — stay on
         # the hub's dirty list for its next round (no decode

@@ -25,6 +25,7 @@ from cleisthenes_tpu.transport.message import (
     CoinPayload,
     DecShareBatchPayload,
     DecSharePayload,
+    EchoBatchPayload,
     Message,
     Payload,
     RbcPayload,
@@ -90,6 +91,10 @@ def _columnarize(buf: List[Payload]) -> List[Payload]:
             key = ("d", p.epoch, p.index)
         elif cls is RbcPayload and p.type is RbcType.READY:
             key = ("r", p.epoch)
+        elif cls is RbcPayload and p.type is RbcType.ECHO:
+            # one turn's ECHO fan-out shares the sender's shard slot
+            # (it echoes the VALs it received, all at its own index)
+            key = ("e", p.epoch, p.shard_index)
         else:
             key = ("solo", len(order))  # preserves position, no merge
         if key in groups:
@@ -134,13 +139,25 @@ def _columnarize(buf: List[Payload]) -> List[Payload]:
                     tuple(p.z for p in run),
                 )
             )
-        else:  # "r"
+        elif tag == "r":
             p0 = run[0]
             out.append(
                 ReadyBatchPayload(
                     p0.epoch,
                     tuple(p.proposer for p in run),
                     tuple(p.root_hash for p in run),
+                )
+            )
+        else:  # "e"
+            p0 = run[0]
+            out.append(
+                EchoBatchPayload(
+                    p0.epoch,
+                    p0.shard_index,
+                    tuple(p.proposer for p in run),
+                    tuple(p.root_hash for p in run),
+                    tuple(p.branch for p in run),
+                    tuple(p.shard for p in run),
                 )
             )
     return out

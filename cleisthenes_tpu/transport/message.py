@@ -218,6 +218,23 @@ class ReadyBatchPayload(NamedTuple):
     roots: Tuple[bytes, ...]
 
 
+class EchoBatchPayload(NamedTuple):
+    """One sender's RBC ECHOes for many instances of one epoch: the
+    sender's shard slot (``shard_index``) is shared — a node echoes
+    the VAL it received, which always carries its own tree position
+    (docs/RBC-EN.md:34) — while (proposer, root, branch, shard) are
+    columnar.  The last of the O(N^2)-per-epoch payload classes to go
+    columnar: at N=64 the scalar ECHO chain was ~262k handler calls
+    per epoch (profiled round 5)."""
+
+    epoch: int
+    shard_index: int
+    proposers: Tuple[str, ...]
+    roots: Tuple[bytes, ...]
+    branches: Tuple[Tuple[bytes, ...], ...]
+    shards: Tuple[bytes, ...]
+
+
 Payload = Union[
     RbcPayload,
     BbaPayload,
@@ -230,6 +247,7 @@ Payload = Union[
     CoinBatchPayload,
     DecShareBatchPayload,
     ReadyBatchPayload,
+    EchoBatchPayload,
 ]
 
 # oneof discriminants (reference message.proto:18-22 has rbc=3, bba=4;
@@ -245,6 +263,7 @@ _KIND_BBA_BATCH = 10
 _KIND_COIN_BATCH = 11
 _KIND_DEC_BATCH = 12
 _KIND_READY_BATCH = 13
+_KIND_ECHO_BATCH = 14
 
 # DoS bound on per-instance columns (a roster is <= 256 under the
 # GF(2^8) shard cap; 4096 leaves margin for multi-round merges)
@@ -427,6 +446,21 @@ def _encode_payload(p: Payload) -> Tuple[int, bytes]:
             _pack_str(out, s)
             _pack_bytes(out, p.roots[i])
         return _KIND_READY_BATCH, b"".join(out)
+    if isinstance(p, EchoBatchPayload):
+        _check_batch_len(
+            len(p.proposers), len(p.roots), len(p.branches), len(p.shards)
+        )
+        out.append(struct.pack(">QI", p.epoch, p.shard_index))
+        out.append(struct.pack(">I", len(p.proposers)))
+        for i, s in enumerate(p.proposers):
+            _pack_str(out, s)
+            _pack_bytes(out, p.roots[i])
+            br = p.branches[i]
+            out.append(struct.pack(">I", len(br)))
+            for b in br:
+                _pack_bytes(out, b)
+            _pack_bytes(out, p.shards[i])
+        return _KIND_ECHO_BATCH, b"".join(out)
     raise TypeError(f"unknown payload type {type(p)!r}")
 
 
@@ -624,6 +658,44 @@ def _parse_payload(d: bytes, o: int, end: int, kind: int):
             ReadyBatchPayload(epoch, tuple(proposers), tuple(roots)),
             o,
         )
+    if kind == _KIND_ECHO_BATCH:
+        if o + 16 > end:
+            raise ValueError("truncated frame")
+        (epoch,) = _U64.unpack_from(d, o)
+        (sidx,) = _U32.unpack_from(d, o + 8)
+        (count,) = _U32.unpack_from(d, o + 12)
+        _check_batch_count(count)
+        o += 16
+        proposers, roots, branches, shards = [], [], [], []
+        for _ in range(count):
+            s, o = _field(d, o, end)
+            proposers.append(s.decode("utf-8"))
+            r, o = _field(d, o, end)
+            roots.append(r)
+            if o + 4 > end:
+                raise ValueError("truncated frame")
+            (nbr,) = _U32.unpack_from(d, o)
+            if nbr > 64:  # same Merkle depth cap as _KIND_RBC
+                raise ValueError(f"branch length {nbr} exceeds cap")
+            o += 4
+            br = []
+            for _ in range(nbr):
+                b, o = _field(d, o, end)
+                br.append(b)
+            branches.append(tuple(br))
+            sh, o = _field(d, o, end)
+            shards.append(sh)
+        return (
+            EchoBatchPayload(
+                epoch,
+                sidx,
+                tuple(proposers),
+                tuple(roots),
+                tuple(branches),
+                tuple(shards),
+            ),
+            o,
+        )
     if kind == _KIND_SYNC_REQ:
         if o + 8 > end:
             raise ValueError("truncated frame")
@@ -776,6 +848,7 @@ __all__ = [
     "CoinBatchPayload",
     "DecShareBatchPayload",
     "ReadyBatchPayload",
+    "EchoBatchPayload",
     "RbcType",
     "BbaType",
     "encode_message",

@@ -133,3 +133,25 @@ def test_acs_output_fires_exactly_once():
     assert len(fired) == 1
     assert fired[0][0] == 0
     assert fired[0][1] == acss["node1"].output()
+
+
+def test_coin_index_replay_does_not_stall():
+    """A Byzantine member re-issuing an HONEST node's coin shares
+    (same Shamir index, valid CP proof — the textbook share replay)
+    must not stall any instance's coin: a threshold-SIZE pool can be
+    index-under-covered, and the row store's watch re-notification
+    must pull genuinely distinct indices as they arrive (the coin
+    analog of the round-4 dec-share crossing-stall regression)."""
+    for seed in (None, 3, 11):
+        cfg, net, acss = make_acs_network(4, seed=seed)
+        # node3 clones node0's coin secret: every share it issues is a
+        # byte-perfect replay of node0's (valid, index-colliding)
+        donor = acss["node0"].bbas["node0"].coin_secret
+        for bba in acss["node3"].bbas.values():
+            bba.coin_secret = donor
+        props = proposals(acss)
+        for nid, acs in acss.items():
+            acs.input(props[nid])
+        net.run()
+        out = assert_common_output(acss)
+        assert set(out) == set(props) or len(out) >= len(acss) - cfg.f

@@ -324,6 +324,7 @@ def test_codec_fuzz_never_crashes():
         CoinPayload,
         DecShareBatchPayload,
         DecSharePayload,
+        EchoBatchPayload,
         Message,
         RbcPayload,
         RbcType,
@@ -354,6 +355,10 @@ def test_codec_fuzz_never_crashes():
                     DecShareBatchPayload(1, 2, ("a", "b"), (1, 2), (3, 4),
                                          (5, 6)),
                     ReadyBatchPayload(1, ("a", "b"), (b"q" * 32, b"w" * 32)),
+                    EchoBatchPayload(
+                        1, 3, ("a", "b"), (b"q" * 32, b"w" * 32),
+                        ((b"x" * 32,), (b"y" * 32,)), (b"s1", b"s2"),
+                    ),
                 )
             ),
             b"m" * 32,
@@ -385,3 +390,34 @@ def test_codec_fuzz_never_crashes():
             decode_frame(blob)
         except ValueError:
             pass
+
+
+def test_echo_batch_columnarizes_and_roundtrips():
+    """A turn's ECHO fan-out (one per instance, all at the sender's
+    shard slot) merges into ONE EchoBatchPayload — the last
+    O(N^2)-per-epoch class to go columnar — and survives the codec."""
+    from cleisthenes_tpu.transport.broadcast import _columnarize
+    from cleisthenes_tpu.transport.message import (
+        EchoBatchPayload,
+        Message,
+        RbcPayload,
+        RbcType,
+        decode_frame,
+        encode_message,
+    )
+
+    echoes = [
+        RbcPayload(
+            RbcType.ECHO, f"p{i}", 7, bytes([i]) * 32,
+            (bytes([i]) * 32, bytes([64 + i]) * 32), bytes([i]) * 16, 3,
+        )
+        for i in range(4)
+    ]
+    items = _columnarize(list(echoes))
+    assert len(items) == 1 and isinstance(items[0], EchoBatchPayload)
+    batch = items[0]
+    assert batch.epoch == 7 and batch.shard_index == 3
+    assert batch.proposers == tuple(f"p{i}" for i in range(4))
+    wire = encode_message(Message("s", 1.0, batch, b"m" * 32))
+    got, _prefix = decode_frame(wire)
+    assert got.payload == batch

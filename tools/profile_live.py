@@ -21,6 +21,14 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+# pin the platform BEFORE anything imports jax: the image's
+# sitecustomize registers the axon PJRT plugin at interpreter boot,
+# and with the relay down the env var alone leaves init hanging on it
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 import numpy as np  # noqa: E402
 
 import bench  # noqa: E402
