@@ -257,6 +257,22 @@ def setup_keys(
 # ---------------------------------------------------------------------------
 
 
+# the payload classes the ACS layer consumes (set-membership dispatch:
+# _serve_payload runs O(N^2) times per wave and the isinstance chain
+# was measurable at N=64)
+_ACS_PAYLOADS = frozenset(
+    (
+        RbcPayload,
+        BbaPayload,
+        CoinPayload,
+        BbaBatchPayload,
+        CoinBatchPayload,
+        ReadyBatchPayload,
+        EchoBatchPayload,
+    )
+)
+
+
 def _logical_count(p) -> int:
     """Logical protocol messages in one payload: a columnar batch
     carries one vote/share PER INSTANCE, and msgs_in counts logical
@@ -589,10 +605,11 @@ class HoneyBadger:
             return
         # state-sync traffic is deliberately NOT epoch-window gated:
         # it exists exactly for nodes outside the window
-        if isinstance(payload, SyncRequestPayload):
+        pcls = payload.__class__
+        if pcls is SyncRequestPayload:
             self._handle_sync_request(sender_id, payload)
             return
-        if isinstance(payload, SyncResponsePayload):
+        if pcls is SyncResponsePayload:
             self._handle_sync_response(sender_id, payload)
             return
         # fast path: an existing state is by construction inside the
@@ -605,25 +622,17 @@ class HoneyBadger:
                 # peers are far ahead: we missed epochs, catch up
                 self._request_sync()
             return
-        if isinstance(payload, DecSharePayload):
+        cls = pcls
+        if cls is DecSharePayload:
             self._handle_dec_share(
                 epoch, es, sender_id, payload.proposer, payload.index,
                 payload.d, payload.e, payload.z,
             )
-        elif isinstance(payload, DecShareBatchPayload):
+            return
+        if cls is DecShareBatchPayload:
             self._handle_dec_share_batch(epoch, es, sender_id, payload)
-        elif isinstance(
-            payload,
-            (
-                RbcPayload,
-                BbaPayload,
-                CoinPayload,
-                BbaBatchPayload,
-                CoinBatchPayload,
-                ReadyBatchPayload,
-                EchoBatchPayload,
-            ),
-        ):
+            return
+        if cls in _ACS_PAYLOADS:
             # follow the epoch: a peer is running it, so contribute our
             # (possibly empty) proposal too — every correct node must
             # propose or ACS never reaches n-f ones
@@ -633,7 +642,6 @@ class HoneyBadger:
                 and not es.proposed
             ):
                 self.start_epoch()
-            cls = payload.__class__
             if cls is BbaBatchPayload:
                 es.acs.handle_bba_batch(sender_id, payload)
             elif cls is CoinBatchPayload:

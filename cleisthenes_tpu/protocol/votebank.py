@@ -72,7 +72,7 @@ class VoteBank:
         self.row_round = np.zeros(n_inst, dtype=np.int64)
         self.active = np.ones(n_inst, dtype=bool)
         self.bbas: List[object] = [None] * n_inst
-        self._prop_cache: Dict[tuple, np.ndarray] = {}
+        self._prop_cache: "Dict[tuple, Tuple[np.ndarray, bool]]" = {}
 
     # -- membership --------------------------------------------------------
 
@@ -136,17 +136,23 @@ class VoteBank:
 
     # -- columnar delivery (ACS batch path) --------------------------------
 
-    def _indices(self, proposers: tuple) -> np.ndarray:
-        arr = self._prop_cache.get(proposers)
-        if arr is None:
+    def _indices(self, proposers: tuple) -> "Tuple[np.ndarray, bool]":
+        """(index array, has_duplicates) — computed once per distinct
+        proposers tuple: honest batches never repeat an instance, so
+        batch_vote's dedup (np.unique, ~30% of its cost) runs only
+        for flagged Byzantine payloads."""
+        ent = self._prop_cache.get(proposers)
+        if ent is None:
             iidx = self.iidx
             arr = np.asarray(
                 [iidx.get(p, -1) for p in proposers], dtype=np.int64
             )
+            dups = len(set(proposers)) != len(proposers)
             if len(self._prop_cache) >= _PROP_CACHE_CAP:
                 self._prop_cache.clear()
-            self._prop_cache[proposers] = arr
-        return arr
+            ent = (arr, dups)
+            self._prop_cache[proposers] = ent
+        return ent
 
     def batch_vote(
         self,
@@ -162,7 +168,7 @@ class VoteBank:
         si = self.sidx.get(sender)
         if si is None:
             return
-        pi = self._indices(proposers)
+        pi, dups = self._indices(proposers)
         pi = pi[pi >= 0]
         if pi.size == 0:
             return
@@ -186,7 +192,8 @@ class VoteBank:
         sel = pi[on]
         if sel.size == 0:
             return
-        sel = np.unique(sel)  # Byzantine batches may repeat instances
+        if dups:  # only Byzantine batches repeat instances
+            sel = np.unique(sel)
         vi = 1 if value else 0
         if is_bval:
             new = sel[~self.bval_seen[sel, si, vi]]
