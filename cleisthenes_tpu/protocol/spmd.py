@@ -88,6 +88,7 @@ class LockstepCluster:
         member_ids: Optional[Sequence[str]] = None,
         group=None,
         coin_block_doubling: bool = True,
+        coin_block_initial: int = 1,
     ) -> None:
         if config is not None:
             if n != 4 and n != config.n:
@@ -122,6 +123,12 @@ class LockstepCluster:
         # #3: speculation's win has to be MEASURED against the relay,
         # not assumed)
         self.coin_block_doubling = coin_block_doubling
+        # first block's round count: 1 = the measured-default doubling
+        # schedule ([0],[1],[2,3],...); 4 = RTT-aggressive ([0..3],
+        # [8-wide],...) — E[decided after 4 rounds] = 15/16 of the
+        # roster, so the extra speculative issue mass buys two fewer
+        # sequential relay round-trips (chip A/B: AB_COIN_BLOCKS)
+        self.coin_block_initial = max(1, int(coin_block_initial))
         self.last_stats: Dict[str, float] = {}
 
     # -- application surface ----------------------------------------------
@@ -328,7 +335,7 @@ class LockstepCluster:
                 coin_bits[(inst, rnd)] = self.coin.toss(coin_id, sub)
 
         next_rnd = 0
-        block = 1
+        block = self.coin_block_initial
         coin_waves = 0
         while undecided and next_rnd < MAX_COIN_ROUNDS:
             rnds = range(

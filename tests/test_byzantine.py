@@ -276,6 +276,12 @@ def test_byzantine_seeded_sweep():
             )
             for hb in honest.values()
         }
+        # strict whole-history equality is SOUND here because these
+        # small rosters drain (run_epochs exits on the drained
+        # condition, not the round cap) — for rosters that may stop at
+        # the cap, the correct assertion is prefix consistency; see
+        # test_byzantine_big_roster_prefix_consistency below and the
+        # round-4 seed-1005 classification (tools/sweep_roster.py)
         assert len(hist) == 1, f"agreement broke at seed {seed} (bad={bad})"
         committed = sum(
             len(b)
@@ -311,3 +317,32 @@ def test_byzantine_duplicate_index_dec_share_does_not_stall():
     assert_identical_batches(nodes)
     committed = sum(len(b) for b in nodes["node1"].committed_batches)
     assert committed >= 12  # liveness held despite the index replay
+
+
+def test_byzantine_big_roster_prefix_consistency():
+    """Big rosters under coalition faults, with a BOUNDED step budget
+    and the CORRECT safety assertion: per-epoch PREFIX consistency
+    among honest nodes (HBBFT agreement), not whole-history equality.
+    The strict-equality sweep above is valid only because its small
+    rosters provably drain; at n in {10, 13} a bounded run stops
+    mid-convergence and honest laggards legitimately hold a prefix
+    (the round-4 seed-1005 classification: tools/sweep_roster.py).
+    """
+    # sweep_common, NOT sweep_roster: the latter registers the
+    # importing process as benchlock-pausable at import time (a bench
+    # capture would SIGSTOP the whole pytest run)
+    from tools.sweep_common import build_seed_scenario, check_prefix
+
+    for seed in (1001, 1013):
+        cfg, net, nodes, bad, honest = build_seed_scenario(seed)
+        for rnd in range(2):
+            for hb in nodes.values():
+                hb.start_epoch()
+            net.run(max_steps=150_000)
+            assert check_prefix(nodes, honest), (
+                f"prefix diverged at seed {seed} round {rnd}"
+            )
+        committed = sum(
+            len(b) for b in nodes[honest[0]].committed_batches
+        )
+        assert committed > 0, f"no progress at seed {seed}"

@@ -124,3 +124,21 @@ def test_lockstep_serial_coin_blocks_match_doubling():
     assert a.last_stats["bba_rounds"] == b.last_stats["bba_rounds"]
     # serial runs one wave per round; doubling compresses the tail
     assert b.last_stats["coin_waves"] == b.last_stats["bba_rounds"]
+
+
+def test_lockstep_aggressive_initial_block_matches():
+    """coin_block_initial=4 (the RTT-aggressive first block) changes
+    dispatch batching only — committed transactions and round counts
+    are identical to the default schedule."""
+    a = LockstepCluster(n=5, batch_size=40, key_seed=9)
+    b = LockstepCluster(
+        n=5, batch_size=40, key_seed=9, coin_block_initial=4
+    )
+    for i in range(80):
+        a.submit(_tx(i))
+        b.submit(_tx(i))
+    a.run_epochs()
+    b.run_epochs()
+    assert _committed_txs(a.committed()) == _committed_txs(b.committed())
+    assert a.last_stats["bba_rounds"] == b.last_stats["bba_rounds"]
+    assert b.last_stats["coin_waves"] <= a.last_stats["coin_waves"]

@@ -11,7 +11,12 @@ the artifact.
 
 Writes AB_COIN_BLOCKS_r05.json atomically after every epoch.
 
-Usage:  python tools/ab_coin_blocks.py [n] [epochs_per_arm]
+Usage:  python tools/ab_coin_blocks.py [n] [epochs_per_arm] [arm ...]
+        arms: doubling (default schedule), serial (block=1 always),
+        aggressive4 (first block covers rounds 0..3 — E[15/16] of the
+        roster decides inside one wave, trading issue mass for two
+        fewer sequential relay round-trips)
+        default arms: doubling serial
 """
 
 from __future__ import annotations
@@ -46,14 +51,28 @@ def _needle_ms() -> float:
     return round((time.perf_counter() - t0) * 1000.0, 1)
 
 
+# arm name -> (coin_block_doubling, coin_block_initial)
+ARMS = {
+    "doubling": (True, 1),
+    "serial": (False, 1),
+    "aggressive4": (True, 4),
+}
+
+
 def main() -> int:
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 128
     per_arm = int(sys.argv[2]) if len(sys.argv) > 2 else 4
+    arms = sys.argv[3:] or ["doubling", "serial"]
+    for a in arms:
+        if a not in ARMS:
+            print(f"unknown arm {a!r}; known: {sorted(ARMS)}",
+                  file=sys.stderr)
+            return 1
     with benchlock.hold("ab_coin_blocks"):
-        return _run(n, per_arm)
+        return _run(n, per_arm, arms)
 
 
-def _run(n: int, per_arm: int) -> int:
+def _run(n: int, per_arm: int, arms) -> int:
     import jax
     import numpy as np
 
@@ -69,30 +88,31 @@ def _run(n: int, per_arm: int) -> int:
         "loadavg": os.getloadavg(),
         "epochs": [],
     }
+    out["arms"] = arms
     batch = out["batch"]
     cluster = LockstepCluster(
         n=n, batch_size=batch, crypto_backend="tpu", key_seed=77
     )
     rng = np.random.default_rng(13)
-    total_epochs = 2 * per_arm + 1  # +1 warm-up
+    total_epochs = len(arms) * per_arm + len(arms)  # + warm-ups
     for _ in range((batch // n) * n * (total_epochs + 1)):
         tx = rng.integers(0, 256, size=64, dtype=np.uint8).tobytes()
         cluster.submit(tx)
-    cluster.run_epoch()  # warm-up / compile (doubling arm shapes)
-    cluster.coin_block_doubling = False
-    cluster.run_epoch()  # warm-up serial-arm shapes too
+    for arm in arms:  # one warm-up per arm: compile its shapes
+        cluster.coin_block_doubling, cluster.coin_block_initial = ARMS[arm]
+        cluster.run_epoch()
     out["warmup_done_utc"] = time.strftime(
         "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
     )
     _write(out)
-    for i in range(2 * per_arm):
-        doubling = i % 2 == 0  # interleave: A,B,A,B,...
-        cluster.coin_block_doubling = doubling
+    for i in range(len(arms) * per_arm):
+        arm = arms[i % len(arms)]  # interleave: same relay weather
+        cluster.coin_block_doubling, cluster.coin_block_initial = ARMS[arm]
         needle = _needle_ms()
         s = cluster.run_epoch()
         out["epochs"].append(
             {
-                "schedule": "doubling" if doubling else "serial",
+                "schedule": arm,
                 "needle_ms": needle,
                 "epoch_s": round(s["epoch_s"], 3),
                 "bba_s": round(s["bba_s"], 3),
@@ -103,7 +123,7 @@ def _run(n: int, per_arm: int) -> int:
         )
         _write(out)
         print(f"[ab] {out['epochs'][-1]}", file=sys.stderr, flush=True)
-    for arm in ("doubling", "serial"):
+    for arm in arms:
         es = [e for e in out["epochs"] if e["schedule"] == arm]
         walls = sorted(e["epoch_s"] for e in es)
         out[arm] = {

@@ -1,0 +1,70 @@
+"""Side-effect-free pieces shared by the sweep driver and the suite.
+
+tools/sweep_roster.py registers itself as a benchlock-pausable job at
+import time (it is an hours-long background process); the in-suite
+big-roster test must NOT inherit that registration — importing THIS
+module is safe anywhere (advisor finding: the test suite was being
+registered for SIGSTOPs).
+"""
+
+from __future__ import annotations
+
+import random
+
+
+def check_prefix(nodes, honest) -> bool:
+    """Per-epoch PREFIX consistency among honest nodes — the real
+    HBBFT agreement property for runs that may stop at a round cap
+    (strict whole-history equality over-claims: honest laggards may
+    hold a prefix mid-convergence).  Prints the earliest divergence."""
+    hists = {
+        k: [tuple(sorted(b.tx_list())) for b in nodes[k].committed_batches]
+        for k in honest
+    }
+    ok = True
+    for i in range(len(honest)):
+        for j in range(i + 1, len(honest)):
+            a, b = hists[honest[i]], hists[honest[j]]
+            m = min(len(a), len(b))
+            if a[:m] != b[:m]:
+                ok = False
+                for e in range(m):
+                    if a[e] != b[e]:
+                        sa, sb = set(a[e]), set(b[e])
+                        print(
+                            f"PREFIX DIVERGES {honest[i]} vs {honest[j]}"
+                            f" at epoch {e}:\n"
+                            f"  only in {honest[i]}: {sorted(sa - sb)[:4]}\n"
+                            f"  only in {honest[j]}: {sorted(sb - sa)[:4]}",
+                            flush=True,
+                        )
+                        break
+    return ok
+
+
+def build_seed_scenario(seed: int):
+    """The big-roster adversarial scenario for ``seed`` — ONE
+    definition, used by both tools/sweep_roster.py (the classifier)
+    and tests/test_byzantine.py (the bounded suite check), so the two
+    can never drift apart.  Returns (cfg, net, nodes, bad, honest)."""
+    from tests.test_byzantine import make_hb_network, push_txs
+    from cleisthenes_tpu.utils.adversary import Coalition
+
+    rng = random.Random(seed)
+    n = rng.choice([10, 13])
+    f = (n - 1) // 3
+    cfg, net, nodes = make_hb_network(n, batch_size=16, seed=seed)
+    bad = rng.sample(sorted(nodes), f)
+    coal = Coalition(bad, seed=seed)
+    for stage, arg in (
+        ("drop", rng.uniform(0.1, 0.6)),
+        ("tamper", rng.uniform(0.0, 0.7)),
+        ("duplicate", rng.uniform(0.0, 0.5)),
+        ("replay", rng.uniform(0.0, 0.5)),
+    ):
+        if rng.random() < 0.7:
+            getattr(coal, stage)(arg)
+    net.fault_filter = coal.filter
+    push_txs(nodes, 3 * n)
+    honest = sorted(k for k in nodes if k not in bad)
+    return cfg, net, nodes, bad, honest

@@ -23,15 +23,13 @@ Exit:   0 = all seeds prefix-consistent; 2 = divergence (printed).
 from __future__ import annotations
 
 import os
-import random
 import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from tests.test_byzantine import make_hb_network, push_txs  # noqa: E402
-from cleisthenes_tpu.utils.adversary import Coalition  # noqa: E402
 from tools import benchlock  # noqa: E402
+from tools.sweep_common import build_seed_scenario, check_prefix  # noqa: E402,F401
 
 # hours-long low-priority job: a bench capture seizing a TPU window
 # SIGSTOPs us for its duration instead of sharing the one core
@@ -40,50 +38,9 @@ benchlock.register_pausable()
 MAX_ROUNDS = int(os.environ.get("SWEEP_MAX_ROUNDS", "40"))
 
 
-def check_prefix(nodes, honest) -> bool:
-    hists = {
-        k: [tuple(sorted(b.tx_list())) for b in nodes[k].committed_batches]
-        for k in honest
-    }
-    ok = True
-    for i in range(len(honest)):
-        for j in range(i + 1, len(honest)):
-            a, b = hists[honest[i]], hists[honest[j]]
-            m = min(len(a), len(b))
-            if a[:m] != b[:m]:
-                ok = False
-                for e in range(m):
-                    if a[e] != b[e]:
-                        sa, sb = set(a[e]), set(b[e])
-                        print(
-                            f"PREFIX DIVERGES {honest[i]} vs {honest[j]}"
-                            f" at epoch {e}:\n"
-                            f"  only in {honest[i]}: {sorted(sa - sb)[:4]}\n"
-                            f"  only in {honest[j]}: {sorted(sb - sa)[:4]}",
-                            flush=True,
-                        )
-                        break
-    return ok
-
-
 def run_seed(seed: int) -> bool:
-    rng = random.Random(seed)
-    n = rng.choice([10, 13])
-    f = (n - 1) // 3
-    cfg, net, nodes = make_hb_network(n, batch_size=16, seed=seed)
-    bad = rng.sample(sorted(nodes), f)
-    coal = Coalition(bad, seed=seed)
-    for stage, arg in (
-        ("drop", rng.uniform(0.1, 0.6)),
-        ("tamper", rng.uniform(0.0, 0.7)),
-        ("duplicate", rng.uniform(0.0, 0.5)),
-        ("replay", rng.uniform(0.0, 0.5)),
-    ):
-        if rng.random() < 0.7:
-            getattr(coal, stage)(arg)
-    net.fault_filter = coal.filter
-    push_txs(nodes, 3 * n)
-    honest = sorted(k for k in nodes if k not in bad)
+    cfg, net, nodes, bad, honest = build_seed_scenario(seed)
+    n, f = cfg.n, cfg.f
     t0 = time.time()
     for rnd in range(MAX_ROUNDS):
         for hb in nodes.values():
