@@ -134,3 +134,24 @@ def test_load_snapshot_shape():
     assert len(snap["loadavg"]) == 3
     assert isinstance(snap["competing_python_procs"], int)
     assert isinstance(snap["paused_jobs"], int)
+
+
+def test_nonblocking_busy_probe_exits_cleanly(tmp_path, monkeypatch):
+    """A busy block=False probe must yield False and EXIT without
+    error: the double-close (EBADF in the outer finally) killed the
+    armed relay watcher the first time a capture held the lock."""
+    from tools import benchlock
+
+    monkeypatch.setattr(
+        benchlock, "LOCK_PATH", str(tmp_path / "lk"), raising=False
+    )
+    monkeypatch.delenv(benchlock._ENV_KEY, raising=False)
+    with benchlock.hold("holder"):
+        # the reentrancy env var is set by the outer hold; a sibling
+        # process would not see it — simulate that sibling
+        monkeypatch.delenv(benchlock._ENV_KEY, raising=False)
+        with benchlock.hold("prober", block=False) as held:
+            assert held is False
+        # reaching here without OSError IS the regression assertion
+    with benchlock.hold("after", block=False) as held:
+        assert held is True

@@ -176,7 +176,10 @@ def hold(name: str, block: bool = True, timeout_s: float = 7200.0):
             try:
                 fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
             except BlockingIOError:
-                os.close(fd)
+                # the outer finally closes fd — closing here too made
+                # every busy non-blocking probe die with EBADF on
+                # exit, killing the armed relay watcher the first
+                # time a capture held the lock (round-5 regression)
                 yield False
                 return
         os.ftruncate(fd, 0)
