@@ -339,10 +339,15 @@ def issue_shares_batch(
     ws = []
     g_exps: List[int] = []
     by_base: Dict[int, List[int]] = {}
+    # ONE urandom draw for the whole wave (a lockstep wave issues
+    # ~N^2 shares; per-item token_bytes was one syscall each), sliced
+    # per item — same unbiased nonce rule (and reason) as issue_share
+    stride = nbytes + 8
+    nonce_pool = secrets.token_bytes(stride * len(items))
+    off = 0
     for share, base, _context, vk in items:
-        w = (
-            int.from_bytes(secrets.token_bytes(nbytes + 8), "big") % q
-        )  # unbiased nonce: same rule (and reason) as issue_share
+        w = int.from_bytes(nonce_pool[off : off + stride], "big") % q
+        off += stride
         ws.append(w)
         g_exps.append(w)  # a1 = g^w
         if vk is None:
