@@ -683,31 +683,10 @@ def run_child() -> None:
             + modexp_comparator_note()
         ),
     })
-    for name, pc in PROTO_CONFIGS.items():
-        progress(name)
-        if on_tpu:
-            # Both backends run every live-protocol section on a real
-            # chip: the host floors (ModEngine.HOST_FLOOR,
-            # XlaMerkle.HOST_FLOOR_*) route sub-crossover batches to
-            # the native kernels, so the 'tpu' backend no longer
-            # drowns small-N waves in per-dispatch RTT (the round-2
-            # failure mode that made n64-accelerated opt-in).
-            out[name] = protocol_section(
-                "tpu", cpu_ref, pc["n"], pc["batch"], pc["epochs"]
-            )
-        else:
-            # Relay-down fallback: XLA-on-host 'tpu' numbers are a
-            # meaningless stand-in AND slow — the full fallback run
-            # measured 74 min, a budget risk for the driver.  Record
-            # the native-path numbers only.
-            out[name] = {
-                "n": pc["n"], "batch": pc["batch"],
-                "cpu": measure_protocol(
-                    cpu_ref, pc["n"], pc["batch"], pc["epochs"]
-                ),
-                "tpu": None, "vs_cpu": None,
-                "note": "accelerated side skipped: no TPU attached",
-            }
+    # Section order is salvage-priority order: a dying window (or the
+    # parent's child timeout) keeps the sections already persisted, so
+    # the headline lockstep/wide sections run BEFORE the slow live-
+    # protocol ones (round 5 lost a 50-min capture tail-first).
     # full-protocol lockstep epochs at the BASELINE config-4 scale
     # (N=128, f=42, 10k-tx batches) — the SPMD executor
     progress("protocol_spmd_n128 cpu")
@@ -819,6 +798,34 @@ def run_child() -> None:
             "note": "skipped: no TPU attached (XLA-on-host wide-limb "
             "numbers are meaningless and ~85 s of budget)"
         }
+    # live-protocol sections (the slowest) run LAST: see the salvage-
+    # priority note above
+    for name, pc in PROTO_CONFIGS.items():
+        progress(name)
+        if on_tpu:
+            # Both backends run every live-protocol section on a real
+            # chip: the host floors (ModEngine.HOST_FLOOR,
+            # XlaMerkle.HOST_FLOOR_*) route sub-crossover batches to
+            # the native kernels, so the 'tpu' backend no longer
+            # drowns small-N waves in per-dispatch RTT (the round-2
+            # failure mode that made n64-accelerated opt-in).
+            out[name] = protocol_section(
+                "tpu", cpu_ref, pc["n"], pc["batch"], pc["epochs"]
+            )
+        else:
+            # Relay-down fallback: XLA-on-host 'tpu' numbers are a
+            # meaningless stand-in AND slow — the full fallback run
+            # measured 74 min, a budget risk for the driver.  Record
+            # the native-path numbers only.
+            out[name] = {
+                "n": pc["n"], "batch": pc["batch"],
+                "cpu": measure_protocol(
+                    cpu_ref, pc["n"], pc["batch"], pc["epochs"]
+                ),
+                "tpu": None, "vs_cpu": None,
+                "note": "accelerated side skipped: no TPU attached",
+            }
+    progress("done")  # persists the live sections before finalizing
     provenance["end_utc"] = time.strftime(
         "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
     )
