@@ -17,7 +17,11 @@
 #      (transport/byzantine), this stack's answer to `-race`
 #      (SURVEY.md §5.2: replayable interleavings instead of a dynamic
 #      race detector), plus the real-thread gRPC suite
-#   4. full tier           — everything, including the N=64 slow test
+#   4. fault tier          — the crash/partition/adversary suite
+#      (`-m faults`: Byzantine coalitions, crash+WAL-restart+CATCHUP,
+#      gRPC backoff redial) replayed over a fixed 3-seed matrix, so a
+#      fault-handling regression on ANY matrix seed gates the merge
+#   5. full tier           — everything, including the N=64 slow test
 #      (skipped when CI_FAST=1)
 #
 # Usage:  ./ci.sh          # full gate
@@ -26,22 +30,33 @@
 set -euo pipefail
 cd "$(dirname "$0")"
 
-echo "== [1/4] syntax + format gate"
+echo "== [1/5] syntax + format gate"
 python -m compileall -q cleisthenes_tpu tests bench.py __graft_entry__.py
 python tools/format_gate.py
 
-echo "== [2/4] fast tests (with coverage gate)"
+echo "== [2/5] fast tests (with coverage gate)"
 COVGATE_MIN="${COVGATE_MIN:-85}" \
     python -m pytest tests/ -q -m "not slow" -x -p tools.covgate
 
-echo "== [3/4] race-analog: seeded-scheduler + threaded-transport suites"
+echo "== [3/5] race-analog: seeded-scheduler + threaded-transport suites"
 python -m pytest tests/test_transport.py tests/test_byzantine.py \
     tests/test_grpc.py -q -x
 
+echo "== [4/5] fault gate: crash/partition/adversary suite, 3-seed matrix"
+# the full faults-marked suite already ran at the default seed in
+# stages 2-3; the matrix replays the FAULT_SEED-parametrized
+# crash+WAL-restart+CATCHUP scenario (the seed-sensitive entry point)
+# at every matrix seed, so a fault regression on ANY seed gates
+for seed in 11 23 47; do
+    echo "   -- FAULT_SEED=$seed"
+    FAULT_SEED="$seed" python -m pytest tests/test_byzantine.py -q -x \
+        -m faults -k crash_restart_wal_catchup
+done
+
 if [[ "${CI_FAST:-0}" == "1" ]]; then
-    echo "== [4/4] skipped (CI_FAST=1)"
+    echo "== [5/5] skipped (CI_FAST=1)"
 else
-    echo "== [4/4] full suite incl. scale tests"
+    echo "== [5/5] full suite incl. scale tests"
     python -m pytest tests/ -q -m slow
 fi
 

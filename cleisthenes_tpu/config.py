@@ -19,6 +19,12 @@ from typing import Optional
 
 DEFAULT_DIAL_TIMEOUT_S = 3.0  # reference comm.go:107-109
 DEFAULT_CHANNEL_CAPACITY = 200  # reference conn.go:60-61 (out/read chans)
+# Self-healing dial layer (transport/host.py): first retry delay and
+# the cap of the exponential backoff.  The reference redials never
+# (a lost stream stays lost); a fixed-interval retry is the other
+# failure mode — it synchronizes a whole roster's redial storms.
+DEFAULT_DIAL_RETRY_BASE_S = 0.05
+DEFAULT_DIAL_RETRY_MAX_S = 5.0
 
 
 @dataclasses.dataclass
@@ -38,7 +44,19 @@ class Config:
         GF kernels) or 'tpu' (batched JAX/XLA kernels) — the
         BatchCrypto/ErasureCoder seam from BASELINE.json.
       dial_timeout_s: client dial timeout (reference comm.go:107-109).
+      dial_retry_base_s / dial_retry_max_s: redial policy for the
+        self-healing gRPC transport — capped exponential backoff with
+        seeded jitter, both for boot-time dials and for streams lost
+        mid-run (transport/host.py, transport/health.py).
       channel_capacity: per-connection mailbox depth (conn.go:60-61).
+      ledger_fsync: fsync-on-commit policy for the durable batch log
+        (core/ledger.py).  False (default) flushes to the OS on every
+        append — surviving process crashes; True additionally fsyncs —
+        surviving host power loss, at ~ms/commit cost.
+      ledger_checkpoint_every: append a dedup-set checkpoint record to
+        the batch log every this-many commits, so a restart seeds the
+        duplicate filter from the checkpoint instead of re-deriving it
+        from every logged batch.  0 disables checkpointing.
       seed: None (default) draws batch-sampling randomness from the OS
         CSPRNG — production mode, keeping proposal selection
         unpredictable (part of HBBFT's censorship-resistance story).
@@ -56,7 +74,11 @@ class Config:
     batch_size: int = 256
     crypto_backend: str = "cpu"
     dial_timeout_s: float = DEFAULT_DIAL_TIMEOUT_S
+    dial_retry_base_s: float = DEFAULT_DIAL_RETRY_BASE_S
+    dial_retry_max_s: float = DEFAULT_DIAL_RETRY_MAX_S
     channel_capacity: int = DEFAULT_CHANNEL_CAPACITY
+    ledger_fsync: bool = False
+    ledger_checkpoint_every: int = 32
     seed: Optional[int] = None
     coin_seed: int = 1
     mesh_shape: Optional[tuple] = None
@@ -77,6 +99,18 @@ class Config:
             raise ValueError(
                 f"n={self.n} must be >= 3f+1={3 * self.f + 1} "
                 "(docs/BBA-EN.md:26: t < n/3)"
+            )
+        if self.dial_retry_base_s <= 0 or (
+            self.dial_retry_max_s < self.dial_retry_base_s
+        ):
+            raise ValueError(
+                f"dial retry policy base={self.dial_retry_base_s} "
+                f"max={self.dial_retry_max_s}: need 0 < base <= max"
+            )
+        if self.ledger_checkpoint_every < 0:
+            raise ValueError(
+                f"ledger_checkpoint_every={self.ledger_checkpoint_every} "
+                "must be >= 0 (0 disables checkpoints)"
             )
         if self.crypto_backend not in ("cpu", "cpp", "tpu"):
             raise ValueError(f"unknown crypto_backend {self.crypto_backend!r}")

@@ -9,10 +9,23 @@ counter, and the duplicate-filter — so a restarted validator rejoins at
 the epoch after its last commit instead of epoch 0.
 
 Record format (all big-endian, following transport.message's TLV
-style):  magic "CLOG" | u32 record_len | u64 epoch | u32 n_proposers |
-per proposer (u32 id_len | id | u32 n_txs | per tx (u32 len | bytes))
-| u32 crc32(record body).  A torn tail (crash mid-append) is detected
-by length/CRC and truncated away on open.
+style):  magic | u32 record_len | body | u32 crc32(record body), with
+two record magics:
+
+  "CLOG" — committed batch: u64 epoch | u32 n_proposers | per
+  proposer (u32 id_len | id | u32 n_txs | per tx (u32 len | bytes)).
+
+  "CCKP" — dedup-set checkpoint: u64 epoch | u32 n_epoch_sets | per
+  set, oldest first (u32 n_txs | per tx (u32 len | bytes)) — a
+  snapshot of the node's bounded committed-tx duplicate filter
+  (HoneyBadger._committed_history) as of ``epoch``.  On restart the
+  filter seeds from the LAST checkpoint and folds only the batches
+  logged after it, instead of re-deriving tx sets from every batch in
+  the log.
+
+A torn tail (crash mid-append) is detected by length/CRC and
+truncated away on open.  The fsync-on-commit policy is
+Config.ledger_fsync.
 """
 
 from __future__ import annotations
@@ -21,18 +34,20 @@ import os
 import struct
 import threading
 import zlib
-from typing import Dict, Iterator, List, Optional, Tuple
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
 
 from cleisthenes_tpu.core.batch import Batch
 
 _MAGIC = b"CLOG"
+_MAGIC_CKPT = b"CCKP"
 
 
 def encode_batch_body(epoch: int, batch: Batch) -> bytes:
     """The CRC-covered record body: (epoch, contributions).  Also the
-    payload of state-sync responses (transport.message
-    SyncResponsePayload), so a synced batch round-trips through the
-    exact bytes a local commit would have logged."""
+    payload of CATCHUP responses (transport.message
+    CatchupRespPayload), so a caught-up batch round-trips through the
+    exact bytes a local commit would have logged — and f+1 "identical
+    bodies" means f+1 identical LOG RECORDS."""
     return _encode_body(epoch, batch)
 
 
@@ -56,14 +71,56 @@ def _encode_body(epoch: int, batch: Batch) -> bytes:
     return b"".join(out)
 
 
-def _encode_record(epoch: int, batch: Batch) -> bytes:
-    body = _encode_body(epoch, batch)
+def _frame_record(magic: bytes, body: bytes) -> bytes:
     return (
-        _MAGIC
+        magic
         + struct.pack(">I", len(body))
         + body
         + struct.pack(">I", zlib.crc32(body))
     )
+
+
+def _encode_record(epoch: int, batch: Batch) -> bytes:
+    return _frame_record(_MAGIC, _encode_body(epoch, batch))
+
+
+def _encode_checkpoint_body(
+    epoch: int, history: Sequence[Set[bytes]]
+) -> bytes:
+    out: List[bytes] = [
+        struct.pack(">Q", epoch),
+        struct.pack(">I", len(history)),
+    ]
+    for seen in history:
+        out.append(struct.pack(">I", len(seen)))
+        for tx in sorted(seen):  # deterministic bytes for a given set
+            out.append(struct.pack(">I", len(tx)))
+            out.append(tx)
+    return b"".join(out)
+
+
+def _decode_checkpoint_body(body: bytes) -> Tuple[int, List[Set[bytes]]]:
+    off = 0
+
+    def u32() -> int:
+        nonlocal off
+        (v,) = struct.unpack_from(">I", body, off)
+        off += 4
+        return v
+
+    (epoch,) = struct.unpack_from(">Q", body, off)
+    off += 8
+    history: List[Set[bytes]] = []
+    for _ in range(u32()):
+        seen: Set[bytes] = set()
+        for _ in range(u32()):
+            tx_len = u32()
+            seen.add(body[off : off + tx_len])
+            off += tx_len
+        history.append(seen)
+    if off != len(body):
+        raise ValueError("trailing bytes in checkpoint record")
+    return epoch, history
 
 
 def _decode_body(body: bytes) -> Tuple[int, Batch]:
@@ -101,18 +158,20 @@ class BatchLog:
         self.fsync = fsync
         self._lock = threading.Lock()
         self._last_epoch: Optional[int] = None
+        self._last_checkpoint: Optional[Tuple[int, List[Set[bytes]]]] = None
         self._recover()
         self._fh = open(path, "ab")
 
     @staticmethod
-    def _scan(data: bytes) -> Iterator[Tuple[int, bytes]]:
-        """Walk validated records: yields (end_offset, body) for every
-        record whose framing, CRC and body parse check out, stopping
-        at the first torn/corrupt one.  The single source of framing
-        truth for both recovery and replay."""
+    def _scan(data: bytes) -> Iterator[Tuple[int, bytes, bytes]]:
+        """Walk validated records: yields (end_offset, magic, body) for
+        every record whose framing, CRC and body parse check out,
+        stopping at the first torn/corrupt one.  The single source of
+        framing truth for both recovery and replay."""
         off = 0
         while off + 8 <= len(data):
-            if data[off : off + 4] != _MAGIC:
+            magic = data[off : off + 4]
+            if magic != _MAGIC and magic != _MAGIC_CKPT:
                 return
             (body_len,) = struct.unpack_from(">I", data, off + 4)
             end = off + 8 + body_len + 4
@@ -123,10 +182,13 @@ class BatchLog:
             if zlib.crc32(body) != crc:
                 return
             try:
-                _decode_body(body)
+                if magic == _MAGIC:
+                    _decode_body(body)
+                else:
+                    _decode_checkpoint_body(body)
             except (ValueError, struct.error, UnicodeDecodeError):
                 return
-            yield end, body
+            yield end, magic, body
             off = end
 
     def _recover(self) -> None:
@@ -136,32 +198,60 @@ class BatchLog:
         with open(self.path, "rb") as fh:
             data = fh.read()
         good_end = 0
-        for end, body in self._scan(data):
-            self._last_epoch, _ = _decode_body(body)
+        for end, magic, body in self._scan(data):
+            if magic == _MAGIC:
+                self._last_epoch, _ = _decode_body(body)
+            else:
+                epoch, history = _decode_checkpoint_body(body)
+                self._last_checkpoint = (epoch, history)
             good_end = end
         if good_end < len(data):  # torn/corrupt tail: drop it
             with open(self.path, "r+b") as fh:
                 fh.truncate(good_end)
 
+    def _append_record(self, rec: bytes) -> None:
+        self._fh.write(rec)
+        self._fh.flush()
+        if self.fsync:
+            os.fsync(self._fh.fileno())
+
     def append(self, epoch: int, batch: Batch) -> None:
         rec = _encode_record(epoch, batch)
         with self._lock:
-            self._fh.write(rec)
-            self._fh.flush()
-            if self.fsync:
-                os.fsync(self._fh.fileno())
+            self._append_record(rec)
             self._last_epoch = epoch
 
+    def append_checkpoint(
+        self, epoch: int, history: Sequence[Set[bytes]]
+    ) -> None:
+        """Snapshot the bounded dedup window (oldest epoch-set first)
+        as of ``epoch``'s commit.  A torn checkpoint truncates away on
+        the next open exactly like a torn batch record."""
+        rec = _frame_record(
+            _MAGIC_CKPT, _encode_checkpoint_body(epoch, history)
+        )
+        with self._lock:
+            self._append_record(rec)
+            self._last_checkpoint = (epoch, [set(s) for s in history])
+
     def replay(self) -> Iterator[Tuple[int, Batch]]:
-        """All committed (epoch, batch) records, oldest first."""
+        """All committed (epoch, batch) records, oldest first
+        (checkpoint records are skipped — see ``last_checkpoint``)."""
         with open(self.path, "rb") as fh:
             data = fh.read()
-        for _end, body in self._scan(data):
-            yield _decode_body(body)
+        for _end, magic, body in self._scan(data):
+            if magic == _MAGIC:
+                yield _decode_body(body)
 
     @property
     def last_epoch(self) -> Optional[int]:
         return self._last_epoch
+
+    @property
+    def last_checkpoint(self) -> Optional[Tuple[int, List[Set[bytes]]]]:
+        """(epoch, dedup epoch-sets) of the newest checkpoint record,
+        or None when the log holds no (intact) checkpoint."""
+        return self._last_checkpoint
 
     def close(self) -> None:
         with self._lock:

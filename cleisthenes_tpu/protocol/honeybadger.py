@@ -57,6 +57,8 @@ from cleisthenes_tpu.transport.message import (
     BbaBatchPayload,
     BbaPayload,
     BundlePayload,
+    CatchupReqPayload,
+    CatchupRespPayload,
     CoinBatchPayload,
     CoinPayload,
     DecShareBatchPayload,
@@ -65,8 +67,6 @@ from cleisthenes_tpu.transport.message import (
     Message,
     RbcPayload,
     ReadyBatchPayload,
-    SyncRequestPayload,
-    SyncResponsePayload,
 )
 
 # Sliding epoch window: how many settled epochs stay responsive for
@@ -75,6 +75,23 @@ KEEP_BEHIND = 2
 EPOCH_HORIZON = 8
 # epochs of committed-tx memory for lazy duplicate filtering
 COMMITTED_MEMORY_EPOCHS = 64
+# CATCHUP serving cap: epochs one CatchupReq answers with (the
+# requester chases the next window as it adopts), and how far past a
+# node's own frontier it tallies responses (bounds tally memory
+# against a Byzantine peer spraying far-future epochs)
+CATCHUP_MAX_EPOCHS = 32
+CATCHUP_WINDOW = 128
+# serving-side amplification guard: a sender whose from_epoch does not
+# advance past the window already served it gets this many repeat
+# serves, re-armed on every local epoch advance (an 8-byte CatchupReq
+# otherwise buys CATCHUP_MAX_EPOCHS full batch bodies — a free 32x
+# bandwidth/CPU amplifier for a Byzantine member looping requests).
+# Counted, not clocked: seeded deterministic runs replay exactly.
+CATCHUP_REPEAT_BUDGET = 2
+# a laggard whose CatchupReq (or its responses) was lost re-broadcasts
+# after every this-many further sightings of far-ahead traffic — a
+# deterministic, traffic-driven retry (no timers in the protocol plane)
+CATCHUP_RENUDGE_EVERY = 32
 
 MAX_TXS_PER_LIST = 1_000_000
 
@@ -424,15 +441,36 @@ class HoneyBadger:
         # durable committed-batch log (core.ledger.BatchLog): restore
         # the committed history + epoch counter + dup-filter on restart
         self.batch_log = batch_log
+        self._commits_since_ckpt = 0
         if batch_log is not None and batch_log.last_epoch is not None:
+            # seed the dup-filter from the last checkpoint (if any) and
+            # fold only the batches logged after it; the full batch
+            # history is still replayed for catch-up serving
+            ckpt_epoch = -1
+            ckpt = batch_log.last_checkpoint
+            if ckpt is not None:
+                ckpt_epoch, history = ckpt
+                for seen in history:
+                    self._remember_committed(set(seen))
             for epoch, batch in batch_log.replay():
                 self.committed_batches.append(batch)
-                self._remember_committed(set(batch.tx_list()))
+                if epoch > ckpt_epoch:
+                    self._remember_committed(set(batch.tx_list()))
             self.epoch = batch_log.last_epoch + 1
-        # state-sync: epoch -> sender -> response body (f+1 identical
-        # bodies for the NEXT epoch let a laggard adopt it directly)
-        self._sync_responses: Dict[str, bytes] = {}
-        self._last_sync_request: Optional[int] = None
+        # CATCHUP: epoch -> sender -> response body.  Epochs adopt in
+        # order at the commit frontier, each on f+1 identical bodies
+        # (>= 1 honest sender => the true committed batch).
+        self._catchup_tallies: Dict[int, Dict[str, bytes]] = {}
+        self._last_catchup_request: Optional[int] = None
+        self._farahead_sightings = 0
+        # serving-side guard state (all counted, never clocked):
+        # sender -> end of the last window served (its next request
+        # must reach it to be served unconditionally); sender ->
+        # remaining non-advancing repeat serves; sender -> the last
+        # from_epoch it asked for (re-served when its link heals)
+        self._catchup_floor: Dict[str, int] = {}
+        self._catchup_repeats: Dict[str, int] = {}
+        self._catchup_last_req: Dict[str, int] = {}
 
     def _remember_committed(self, seen: Set[bytes]) -> None:
         """Fold one epoch's committed txs into the bounded duplicate
@@ -600,17 +638,18 @@ class HoneyBadger:
             self._exit_turn()
 
     def _serve_payload(self, sender_id: str, payload) -> None:
+        # CATCHUP traffic is deliberately NOT epoch-window gated: it
+        # exists exactly for nodes outside the window (CatchupReq has
+        # no ``epoch`` field at all — it carries a range start)
+        pcls = payload.__class__
+        if pcls is CatchupReqPayload:
+            self._handle_catchup_req(sender_id, payload)
+            return
+        if pcls is CatchupRespPayload:
+            self._handle_catchup_resp(sender_id, payload)
+            return
         epoch = getattr(payload, "epoch", None)
         if epoch is None:
-            return
-        # state-sync traffic is deliberately NOT epoch-window gated:
-        # it exists exactly for nodes outside the window
-        pcls = payload.__class__
-        if pcls is SyncRequestPayload:
-            self._handle_sync_request(sender_id, payload)
-            return
-        if pcls is SyncResponsePayload:
-            self._handle_sync_response(sender_id, payload)
             return
         # fast path: an existing state is by construction inside the
         # window (stale ones are GC'd), so skip the bounds arithmetic
@@ -619,8 +658,17 @@ class HoneyBadger:
         es = self._epochs.get(epoch) or self._epoch_state(epoch)
         if es is None:  # outside the sliding window
             if epoch > self.epoch + EPOCH_HORIZON:
-                # peers are far ahead: we missed epochs, catch up
-                self._request_sync()
+                # peers are far ahead: we missed epochs, catch up.
+                # The first sighting requests immediately (dedup'd per
+                # frontier); if the frontier then fails to move (our
+                # request or its responses were lost), every further
+                # CATCHUP_RENUDGE_EVERY sightings force a re-broadcast
+                # — a retry clocked by traffic, not wall time
+                self._farahead_sightings += 1
+                self._request_catchup(
+                    force=self._farahead_sightings % CATCHUP_RENUDGE_EVERY
+                    == 0
+                )
             return
         cls = pcls
         if cls is DecSharePayload:
@@ -905,84 +953,172 @@ class HoneyBadger:
                     es.decrypted[proposer] = None
             self._maybe_commit(epoch, es)
 
-    # -- state sync (crash-recovery catch-up; SURVEY.md §5.3-5.4) ----------
+    # -- CATCHUP (crash-recovery state transfer; SURVEY.md §5.3-5.4) -------
 
-    def request_sync(self) -> None:
-        """Ask the roster for the committed batch of our current epoch
-        (call after a restart; also fired automatically when peer
-        traffic shows we are more than EPOCH_HORIZON behind)."""
+    def request_catchup(self) -> None:
+        """Ask the roster for every committed batch from our commit
+        frontier on (call after a restart; also fired automatically
+        when peer traffic shows we are more than EPOCH_HORIZON
+        behind).  Peers each answer with up to CATCHUP_MAX_EPOCHS
+        CatchupResp payloads; epochs adopt in order as each collects
+        f+1 identical bodies."""
         try:
-            self._request_sync(force=True)
+            self._request_catchup(force=True)
         finally:
             self._exit_turn()
 
-    def _request_sync(self, force: bool = False) -> None:
-        if not force and self._last_sync_request == self.epoch:
-            return  # one request per epoch value (re-fired as we adopt)
-        self._last_sync_request = self.epoch
-        self.out.broadcast(SyncRequestPayload(epoch=self.epoch))
+    def _request_catchup(self, force: bool = False) -> None:
+        if not force and self._last_catchup_request == self.epoch:
+            return  # one broadcast per frontier (re-fired as we adopt)
+        self._last_catchup_request = self.epoch
+        self.out.broadcast(CatchupReqPayload(from_epoch=self.epoch))
 
-    def _handle_sync_request(
-        self, sender: str, p: SyncRequestPayload
+    def _handle_catchup_req(
+        self, sender: str, p: CatchupReqPayload
     ) -> None:
-        if sender not in self.members:
+        if sender not in self._member_set:
             return
-        if not (0 <= p.epoch < len(self.committed_batches)):
-            return  # we don't have it (or it doesn't exist yet)
+        start = p.from_epoch
+        # remembered even when unservable: if the link to the sender
+        # heals later, peer_reconnected re-serves from here
+        self._catchup_last_req[sender] = start
+        end = min(len(self.committed_batches), start + CATCHUP_MAX_EPOCHS)
+        if not (0 <= start < end):
+            return  # nothing committed there (yet) that we can serve
+        # amplification guard: a legitimately catching-up node's
+        # from_epoch strictly advances past each window we served it;
+        # a request that does NOT advance (replayed frame, Byzantine
+        # request loop, or an honest retry after lost responses) draws
+        # from a small repeat budget re-armed on every local epoch
+        # advance and on link heal — counted, not clocked, so seeded
+        # deterministic runs replay exactly, yet an 8-byte request no
+        # longer buys unlimited 32-batch responses
+        if start < self._catchup_floor.get(sender, 0):
+            budget = self._catchup_repeats.get(
+                sender, CATCHUP_REPEAT_BUDGET
+            )
+            if budget <= 0:
+                return
+            self._catchup_repeats[sender] = budget - 1
+        self._catchup_floor[sender] = max(
+            self._catchup_floor.get(sender, 0), end
+        )
         from cleisthenes_tpu.core.ledger import encode_batch_body
 
-        self.out.send_to(
-            sender,
-            SyncResponsePayload(
-                epoch=p.epoch,
-                body=encode_batch_body(
-                    p.epoch, self.committed_batches[p.epoch]
+        # one response per missed epoch; the coalescing broadcaster
+        # bundles the run into a single envelope for the requester
+        for epoch in range(start, end):
+            self.out.send_to(
+                sender,
+                CatchupRespPayload(
+                    epoch=epoch,
+                    body=encode_batch_body(
+                        epoch, self.committed_batches[epoch]
+                    ),
                 ),
-            ),
-        )
+            )
 
-    def _handle_sync_response(
-        self, sender: str, p: SyncResponsePayload
-    ) -> None:
-        if sender not in self.members or p.epoch != self.epoch:
-            return
-        self._sync_responses[sender] = p.body
-        # f+1 identical bodies include at least one honest node, so
-        # the body is the true committed batch for this epoch
-        counts: Dict[bytes, int] = {}
-        for body in self._sync_responses.values():
-            counts[body] = counts.get(body, 0) + 1
-        body, votes = max(counts.items(), key=lambda kv: kv[1])
-        if votes < self.config.f + 1:
-            return
-        from cleisthenes_tpu.core.ledger import decode_batch_body
-
+    def peer_reconnected(self, member_id: str) -> None:
+        """Transport event: our link to ``member_id`` was just
+        (re-)established.  Responses served while the link was down
+        went into the void, and the requester's per-frontier dedup
+        means it will not ask again on its own — so re-arm the
+        sender's serving budget and re-serve its last requested
+        window.  This is what completes an interrupted state transfer
+        once the self-healing dial layer heals the path (the gRPC
+        crash/rejoin flow); event-driven, so deterministic transports
+        stay deterministic."""
         try:
-            epoch, batch = decode_batch_body(body)
-        except (ValueError, struct.error, UnicodeDecodeError):
-            return
-        if epoch != self.epoch:
-            return
-        self._adopt_synced_batch(epoch, batch)
+            if member_id not in self._member_set:
+                return
+            self._catchup_repeats.pop(member_id, None)
+            last = self._catchup_last_req.get(member_id)
+            if last is not None and last < len(self.committed_batches):
+                self._catchup_floor.pop(member_id, None)
+                self._handle_catchup_req(
+                    member_id, CatchupReqPayload(from_epoch=last)
+                )
+        finally:
+            self._exit_turn()
 
-    def _adopt_synced_batch(self, epoch: int, batch: Batch) -> None:
-        """Commit a batch learned via state sync instead of running the
+    def _handle_catchup_resp(
+        self, sender: str, p: CatchupRespPayload
+    ) -> None:
+        if sender not in self._member_set:
+            return
+        if not (self.epoch <= p.epoch < self.epoch + CATCHUP_WINDOW):
+            return  # stale, or absurdly far ahead: bound tally memory
+        # one vote per (epoch, sender); a re-send overwrites, never adds
+        self._catchup_tallies.setdefault(p.epoch, {})[sender] = p.body
+        adopted = False
+        # adopt in epoch order at the frontier; each adoption may
+        # unlock the NEXT epoch's already-collected quorum
+        while True:
+            tally = self._catchup_tallies.get(self.epoch)
+            if not tally:
+                break
+            counts: Dict[bytes, int] = {}
+            for body in tally.values():
+                counts[body] = counts.get(body, 0) + 1
+            body, votes = max(counts.items(), key=lambda kv: kv[1])
+            if votes < self.config.f + 1:
+                break
+            from cleisthenes_tpu.core.ledger import decode_batch_body
+
+            try:
+                epoch, batch = decode_batch_body(body)
+            except (ValueError, struct.error, UnicodeDecodeError):
+                epoch = None
+            if epoch != self.epoch:
+                # an f+1 quorum always contains an honest sender, so a
+                # winning body that fails decode / claims the wrong
+                # epoch is pure-Byzantine: shed its votes and re-tally
+                for snd in [s for s, b in tally.items() if b == body]:
+                    del tally[snd]
+                continue
+            self._adopt_catchup_batch(epoch, batch)
+            adopted = True
+        if adopted:
+            # the frontier moved: peers may hold more epochs than one
+            # serving window.  Non-forced => the per-frontier dedup
+            # broadcasts exactly once per new frontier value, even if
+            # a sub-quorum (or Byzantine) tally already sits there —
+            # that tally alone must never suppress the chase, or a
+            # single dropped/forged response wedges the catch-up in a
+            # quiescent cluster.
+            self._request_catchup()
+
+    def _adopt_catchup_batch(self, epoch: int, batch: Batch) -> None:
+        """Commit a batch learned via CATCHUP instead of running the
         (long-gone) epoch ourselves."""
-        self.log.info("adopted synced batch", epoch=epoch, txs=len(batch))
+        self.log.info("adopted catch-up batch", epoch=epoch, txs=len(batch))
         self.committed_batches.append(batch)
         seen = set(batch.tx_list())
         self._remember_committed(seen)
         self.metrics.epoch_committed(epoch, len(batch))
         if self.batch_log is not None:
             self.batch_log.append(epoch, batch)
+            self._maybe_log_checkpoint(epoch)
         self._epochs.pop(epoch, None)  # any partial local state is moot
         self.hub.drop_scope((self.node_id, epoch))
-        self._sync_responses.clear()
+        self._catchup_tallies.pop(epoch, None)
         if self.on_commit is not None:
             self.on_commit(epoch, batch)
         self._advance_epoch()
-        # still behind? chase the next epoch immediately
-        self._request_sync(force=True)
+
+    def _maybe_log_checkpoint(self, epoch: int) -> None:
+        """Every Config.ledger_checkpoint_every commits, snapshot the
+        dedup window into the WAL (call AFTER _remember_committed so
+        the checkpoint covers ``epoch`` itself)."""
+        every = self.config.ledger_checkpoint_every
+        if every <= 0:
+            return
+        self._commits_since_ckpt += 1
+        if self._commits_since_ckpt >= every:
+            self._commits_since_ckpt = 0
+            self.batch_log.append_checkpoint(
+                epoch, self._committed_history
+            )
 
     # -- commit (the consensused batch of honeybadger.go:20-21) ------------
 
@@ -1019,13 +1155,22 @@ class HoneyBadger:
         # remember what committed so duplicate local submissions are
         # dropped lazily at poll time (bounded memory)
         self._remember_committed(seen)
+        if self.batch_log is not None:
+            self._maybe_log_checkpoint(epoch)
         if self.on_commit is not None:
             self.on_commit(epoch, batch)
         self._advance_epoch()
 
     def _advance_epoch(self) -> None:
         self.epoch += 1
-        self._sync_responses.clear()  # responses are per-epoch votes
+        for stale in [  # tallies below the frontier can never adopt
+            e for e in self._catchup_tallies if e < self.epoch
+        ]:
+            del self._catchup_tallies[stale]
+        # progress re-arms the catch-up serving budgets and the
+        # far-ahead retry clock (both counted per frontier value)
+        self._catchup_repeats.clear()
+        self._farahead_sightings = 0
         for stale in [
             e for e in self._epochs if e < self.epoch - KEEP_BEHIND
         ]:
@@ -1054,4 +1199,6 @@ __all__ = [
     "deserialize_ciphertext",
     "KEEP_BEHIND",
     "EPOCH_HORIZON",
+    "CATCHUP_MAX_EPOCHS",
+    "CATCHUP_WINDOW",
 ]

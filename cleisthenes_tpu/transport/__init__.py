@@ -9,6 +9,8 @@ channel transport (reference test/mock/stream.go) unchanged.
 from cleisthenes_tpu.transport.message import (
     BbaPayload,
     BbaType,
+    CatchupReqPayload,
+    CatchupRespPayload,
     CoinPayload,
     DecSharePayload,
     Message,
@@ -31,6 +33,8 @@ __all__ = [
     "Message",
     "RbcPayload",
     "BbaPayload",
+    "CatchupReqPayload",
+    "CatchupRespPayload",
     "CoinPayload",
     "DecSharePayload",
     "RbcType",

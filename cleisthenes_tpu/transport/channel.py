@@ -154,11 +154,45 @@ class ChannelNetwork:
     # -- fault injection ---------------------------------------------------
 
     def crash(self, node_id: str) -> None:
-        """Fail-stop: node neither sends nor receives from now on."""
+        """Fail-stop: node neither sends nor receives from now on, and
+        its in-flight frames are lost NOW (a dead host's socket buffers
+        die with it) — so a later restart() cannot resurrect pre-crash
+        traffic as ghost deliveries."""
         self._crashed.add(node_id)
+        kept = [
+            it
+            for it in self._pending
+            if it[0] != node_id and it[1] != node_id
+        ]
+        if isinstance(self._pending, collections.deque):
+            self._pending = collections.deque(kept)
+        else:
+            self._pending = kept
 
     def recover(self, node_id: str) -> None:
+        """Un-crash, keeping the node's old handler (a blip, not a
+        process restart — use restart() for the latter)."""
         self._crashed.discard(node_id)
+
+    def restart(
+        self,
+        node_id: str,
+        handler: Handler,
+        auth: Optional[Authenticator] = None,
+    ) -> None:
+        """Rejoin a crashed node as a restarted PROCESS: fresh handler
+        (typically a HoneyBadger rebuilt from its durable batch log),
+        same identity, empty inbox — pre-crash frames were dropped at
+        crash time.  ``auth`` defaults to the endpoint's existing
+        authenticator (key material survives restarts)."""
+        self._crashed.discard(node_id)
+        ep = self._endpoints.get(node_id)
+        if ep is None:
+            self.join(node_id, handler, auth)
+            return
+        if auth is not None:
+            ep.auth = auth
+        ep.bind(handler)
 
     def partition(self, a: str, b: str) -> None:
         """Drop all traffic between a and b (both directions)."""
@@ -312,4 +346,9 @@ class ChannelNetwork:
         return steps
 
 
-__all__ = ["ChannelNetwork", "ChannelConnection", "ChannelEndpoint", "FaultFilter"]
+__all__ = [
+    "ChannelNetwork",
+    "ChannelConnection",
+    "ChannelEndpoint",
+    "FaultFilter",
+]

@@ -37,6 +37,11 @@ from cleisthenes_tpu.transport.grpc_net import (
     GrpcConnection,
     GrpcServer,
 )
+from cleisthenes_tpu.transport.health import (
+    Backoff,
+    PeerHealthTracker,
+    backoff_rng,
+)
 from cleisthenes_tpu.transport.message import Message, Payload
 from cleisthenes_tpu.utils.log import NodeLogger
 
@@ -234,6 +239,11 @@ class ValidatorHost:
         self.server.on_conn(self._accept)
         self.pool = ConnectionPool()
         self._client = GrpcClient(self._auth)
+        # per-peer UP/DEGRADED/DOWN + reconnect counters + the recent
+        # backoff schedule (proof the dial layer is not spinning)
+        self.health = PeerHealthTracker(
+            p for p in self.members if p != node_id
+        )
         self.out = GrpcPayloadBroadcaster(
             node_id, self.pool, self.dispatcher, self._auth
         )
@@ -241,7 +251,7 @@ class ValidatorHost:
         if batch_log_path is not None:
             from cleisthenes_tpu.core.ledger import BatchLog
 
-            batch_log = BatchLog(batch_log_path)
+            batch_log = BatchLog(batch_log_path, fsync=config.ledger_fsync)
         self.node = HoneyBadger(
             config=config,
             node_id=node_id,
@@ -251,6 +261,7 @@ class ValidatorHost:
             auto_propose=auto_propose,
             batch_log=batch_log,
         )
+        self.node.metrics.set_transport_health(self.health.snapshot)
         self.dispatcher.bind(self.node)
         self._commits: "queue.Queue" = queue.Queue()
         self.node.on_commit = lambda epoch, batch: self._commits.put(
@@ -274,9 +285,9 @@ class ValidatorHost:
     def connect(
         self, addrs: Dict[str, str], deadline_s: float = 10.0
     ) -> None:
-        """Dial every other roster member, retrying until deadline
-        (peers boot concurrently).  Buffered outbound traffic flushes
-        once the pool is complete."""
+        """Dial every other roster member, retrying with capped
+        exponential backoff until deadline (peers boot concurrently).
+        Buffered outbound traffic flushes once the pool is complete."""
         missing = set(self.members) - {self.node_id} - set(addrs)
         if missing:  # config error: fail fast, don't spin the retry loop
             raise ValueError(f"no address for roster members {sorted(missing)}")
@@ -285,35 +296,51 @@ class ValidatorHost:
         for member in self.members:
             if member == self.node_id:
                 continue
-            self._dial_member(
-                member, lambda: time.monotonic() - t0 > deadline_s
-            )
+            backoff = self._backoff_for(member)
+            while True:
+                try:
+                    self._dial_member(member)
+                    break
+                except Exception:
+                    if time.monotonic() - t0 > deadline_s:
+                        raise
+                    delay = backoff.next_delay()
+                    self.health.dial_scheduled(member, delay)
+                    time.sleep(delay)
         self.out.mark_ready()
         self.log.info("connected", peers=len(self.pool))
         if self.node.epoch > 0:
             # restarted from a durable log: peers may have committed
             # epochs we missed — catch up before proposing
-            self.dispatcher.call(self.node.request_sync)
+            self.dispatcher.call(self.node.request_catchup)
 
-    def _dial_member(self, member: str, expired, retry_s: float = 0.05):
-        """Dial one member; retries at ``retry_s`` until ``expired``.
-        ``retry_s=None`` means single attempt (the redial loop owns
-        its own backoff).  Returns the pooled connection."""
-        while True:
-            try:
-                conn = self._client.dial(
-                    DialOpts(
-                        self._addrs[member],
-                        timeout_s=self.config.dial_timeout_s,
-                        capacity=self.config.channel_capacity,
-                        conn_id=member,  # pool addressed by member
-                    )
+    def _backoff_for(self, member: str) -> Backoff:
+        """One dial lane's backoff: Config policy + seeded jitter (the
+        jitter de-synchronizes a roster all redialing the same dead
+        peer; the seed keeps fault tests replayable)."""
+        return Backoff(
+            self.config.dial_retry_base_s,
+            self.config.dial_retry_max_s,
+            rng=backoff_rng(self.config.seed, self.node_id, member),
+        )
+
+    def _dial_member(self, member: str):
+        """Single dial attempt; raises on failure (retry policy is the
+        caller's — connect()'s deadline loop or the redial loop).
+        Returns the pooled connection."""
+        self.health.dial_started(member)
+        try:
+            conn = self._client.dial(
+                DialOpts(
+                    self._addrs[member],
+                    timeout_s=self.config.dial_timeout_s,
+                    capacity=self.config.channel_capacity,
+                    conn_id=member,  # pool addressed by member
                 )
-                break
-            except Exception:
-                if retry_s is None or expired():
-                    raise
-                time.sleep(retry_s)
+            )
+        except Exception:
+            self.health.dial_failed(member)
+            raise
         conn.handle(self.dispatcher)
         # a broken stream prunes itself from the pool and redials in
         # the background (messages sent while down are lost; HBBFT's
@@ -327,10 +354,12 @@ class ValidatorHost:
         )
         conn.start()
         self.pool.add(conn)
+        self.health.connected(member)
         return conn
 
     def _on_conn_lost(self, member: str, conn) -> None:
         self.pool.remove(member)
+        self.health.stream_lost(member)
         self.log.warning("peer stream lost", peer=member)
         if self._stopping.is_set():
             return
@@ -339,19 +368,30 @@ class ValidatorHost:
         ).start()
 
     def _redial_loop(self, member: str) -> None:
-        backoff = 0.1
+        """Self-healing redial: capped exponential backoff with seeded
+        jitter (Config.dial_retry_base_s/_max_s), waking early on
+        stop().  Health transitions UP -> DEGRADED -> DOWN ride the
+        dial attempts (transport/health.py)."""
+        backoff = self._backoff_for(member)
         while not self._stopping.is_set():
             try:
-                conn = self._dial_member(
-                    member, self._stopping.is_set, retry_s=None
-                )
+                conn = self._dial_member(member)
             except Exception:
-                time.sleep(backoff)
-                backoff = min(backoff * 2, 5.0)
+                delay = backoff.next_delay()
+                self.health.dial_scheduled(member, delay)
+                if self._stopping.wait(delay):
+                    return
                 continue
             if self._stopping.is_set():  # stop() raced the dial
                 self.pool.remove(member)
                 conn.close()
+                return
+            # the path to this peer just healed: anything we served it
+            # while the link was down is gone — complete its
+            # interrupted catch-up (no-op if it never asked)
+            self.dispatcher.call(
+                lambda m=member: self.node.peer_reconnected(m)
+            )
             return
 
     def stop(self) -> None:

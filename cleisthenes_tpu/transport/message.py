@@ -8,11 +8,13 @@ Mirrors reference pb/message.proto: an envelope
 ``payload`` field exactly as the reference notes ("marshaled data by
 type", message.proto:27).
 
-Two payload kinds are added beyond the reference's proto — ``COIN``
+Payload kinds are added beyond the reference's proto — ``COIN``
 (threshold common-coin shares, specified at docs/BBA-EN.md:163-181 but
-never given a wire format) and ``DEC`` (TPKE decryption shares,
-docs/THRESHOLD_ENCRYPTION-EN.md:33-36) — because the reference never
-reached the point of needing them on the wire.
+never given a wire format), ``DEC`` (TPKE decryption shares,
+docs/THRESHOLD_ENCRYPTION-EN.md:33-36), and the crash-recovery
+``CATCHUP_REQ``/``CATCHUP_RESP`` pair (state transfer for rejoining
+nodes) — because the reference never reached the point of needing
+them on the wire.
 
 The codec is a deliberate, self-contained binary framing (tag-length-
 value with fixed-width ints) rather than generated protobuf: it keeps
@@ -128,18 +130,23 @@ class DecSharePayload(NamedTuple):
     z: int
 
 
-class SyncRequestPayload(NamedTuple):
-    """Catch-up request from a lagging/restarted node: "send me the
-    committed batch of ``epoch``" (the state-sync step HBBFT itself
-    does not define; SURVEY.md §5.3-5.4 recovery story)."""
+class CatchupReqPayload(NamedTuple):
+    """CATCHUP request from a lagging/restarted node: "send me every
+    committed batch from ``from_epoch`` on" (the state-transfer step
+    HBBFT itself does not define; SURVEY.md §5.3-5.4 recovery story).
+    Peers answer with a RUN of CatchupResp payloads — one per missed
+    epoch they hold, up to a serving cap — so one round trip recovers
+    a whole outage window instead of one epoch per round trip."""
 
-    epoch: int
+    from_epoch: int
 
 
-class SyncResponsePayload(NamedTuple):
-    """One peer's committed batch for ``epoch`` (ledger body bytes).
-    A node adopts it only after f+1 distinct senders agree — at least
-    one of them is honest, so the batch is the true committed one."""
+class CatchupRespPayload(NamedTuple):
+    """One peer's committed batch for ``epoch`` (ledger body bytes,
+    core.ledger.encode_batch_body).  A node adopts an epoch only after
+    f+1 distinct senders return byte-identical bodies — at least one
+    of them is honest, so the batch is the true committed one — and
+    only in epoch order at its own commit frontier."""
 
     epoch: int
     body: bytes
@@ -240,8 +247,8 @@ Payload = Union[
     BbaPayload,
     CoinPayload,
     DecSharePayload,
-    SyncRequestPayload,
-    SyncResponsePayload,
+    CatchupReqPayload,
+    CatchupRespPayload,
     BundlePayload,
     BbaBatchPayload,
     CoinBatchPayload,
@@ -256,8 +263,8 @@ _KIND_RBC = 3
 _KIND_BBA = 4
 _KIND_COIN = 5
 _KIND_DEC = 6
-_KIND_SYNC_REQ = 7
-_KIND_SYNC_RESP = 8
+_KIND_CATCHUP_REQ = 7
+_KIND_CATCHUP_RESP = 8
 _KIND_BUNDLE = 9
 _KIND_BBA_BATCH = 10
 _KIND_COIN_BATCH = 11
@@ -402,13 +409,13 @@ def _encode_payload(p: Payload) -> Tuple[int, bytes]:
         _pack_int(out, p.e)
         _pack_int(out, p.z)
         return _KIND_DEC, b"".join(out)
-    if isinstance(p, SyncRequestPayload):
-        out.append(struct.pack(">Q", p.epoch))
-        return _KIND_SYNC_REQ, b"".join(out)
-    if isinstance(p, SyncResponsePayload):
+    if isinstance(p, CatchupReqPayload):
+        out.append(struct.pack(">Q", p.from_epoch))
+        return _KIND_CATCHUP_REQ, b"".join(out)
+    if isinstance(p, CatchupRespPayload):
         out.append(struct.pack(">Q", p.epoch))
         _pack_bytes(out, p.body)
-        return _KIND_SYNC_RESP, b"".join(out)
+        return _KIND_CATCHUP_RESP, b"".join(out)
     if isinstance(p, BundlePayload):
         if len(p.items) > MAX_BUNDLE_ITEMS:
             raise ValueError(f"bundle of {len(p.items)} items exceeds cap")
@@ -696,17 +703,17 @@ def _parse_payload(d: bytes, o: int, end: int, kind: int):
             ),
             o,
         )
-    if kind == _KIND_SYNC_REQ:
+    if kind == _KIND_CATCHUP_REQ:
         if o + 8 > end:
             raise ValueError("truncated frame")
-        (epoch,) = _U64.unpack_from(d, o)
-        return SyncRequestPayload(epoch), o + 8
-    if kind == _KIND_SYNC_RESP:
+        (from_epoch,) = _U64.unpack_from(d, o)
+        return CatchupReqPayload(from_epoch), o + 8
+    if kind == _KIND_CATCHUP_RESP:
         if o + 8 > end:
             raise ValueError("truncated frame")
         (epoch,) = _U64.unpack_from(d, o)
         body, o = _field(d, o + 8, end)
-        return SyncResponsePayload(epoch, body), o
+        return CatchupRespPayload(epoch, body), o
     if kind == _KIND_BUNDLE:
         if o + 4 > end:
             raise ValueError("truncated frame")
@@ -841,8 +848,8 @@ __all__ = [
     "BbaPayload",
     "CoinPayload",
     "DecSharePayload",
-    "SyncRequestPayload",
-    "SyncResponsePayload",
+    "CatchupReqPayload",
+    "CatchupRespPayload",
     "BundlePayload",
     "BbaBatchPayload",
     "CoinBatchPayload",

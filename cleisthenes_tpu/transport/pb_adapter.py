@@ -32,15 +32,28 @@ from typing import Optional, Tuple
 
 from cleisthenes_tpu.transport.message import (
     BbaPayload,
+    CatchupReqPayload,
+    CatchupRespPayload,
     Message,
     Payload,
     RbcPayload,
+    _KIND_CATCHUP_REQ,
+    _KIND_CATCHUP_RESP,
     _encode_payload,
     _decode_payload,
 )
 
 _WT_VARINT = 0
 _WT_LEN = 2
+
+# Extension slots beyond the reference's oneof (message.proto stops at
+# bba=4): the crash-recovery CATCHUP pair rides high tag numbers as
+# length-delimited messages carrying our TLV body in field 1.  A stock
+# decoder built from the unextended schema skips them per proto3
+# unknown-field semantics, so extended and stock peers interoperate —
+# a reference peer simply cannot serve catch-up.
+_PB_TAG_CATCHUP_REQ = 15
+_PB_TAG_CATCHUP_RESP = 16
 
 # A Byzantine frame must not make us allocate from a length varint.
 MAX_PB_FIELD = 64 * 1024 * 1024
@@ -125,6 +138,12 @@ def encode_pb_message(msg: Message) -> bytes:
         one = _len_field(3, _inner_body(3, p))
     elif isinstance(p, BbaPayload):
         one = _len_field(4, _inner_body(4, p))
+    elif isinstance(p, CatchupReqPayload):
+        _k, tlv = _encode_payload(p)
+        one = _len_field(_PB_TAG_CATCHUP_REQ, _len_field(1, tlv))
+    elif isinstance(p, CatchupRespPayload):
+        _k, tlv = _encode_payload(p)
+        one = _len_field(_PB_TAG_CATCHUP_RESP, _len_field(1, tlv))
     else:
         raise ValueError(
             f"{type(p).__name__} has no slot in the reference's oneof"
@@ -152,7 +171,9 @@ def decode_pb_message(data: bytes, sender_id: str = "") -> Message:
         if wt != _WT_LEN:
             # unknown scalar fields skip per proto3 semantics (forward
             # compatibility); the KNOWN tags are all length-delimited
-            if tag in (1, 2, 3, 4):
+            if tag in (
+                1, 2, 3, 4, _PB_TAG_CATCHUP_REQ, _PB_TAG_CATCHUP_RESP
+            ):
                 raise ValueError(
                     f"wire type {wt} for known tag {tag} (expected LEN)"
                 )
@@ -178,6 +199,8 @@ def decode_pb_message(data: bytes, sender_id: str = "") -> Message:
             ts = _parse_timestamp(body)
         elif tag in (3, 4):
             payload = _parse_inner(tag, body)
+        elif tag in (_PB_TAG_CATCHUP_REQ, _PB_TAG_CATCHUP_RESP):
+            payload = _parse_catchup(tag, body)
         # unknown LEN fields are skipped, per proto3 semantics
     if payload is None:
         raise ValueError("pb.Message carries no rbc/bba payload")
@@ -185,6 +208,29 @@ def decode_pb_message(data: bytes, sender_id: str = "") -> Message:
         sender_id=sender_id, timestamp=ts, payload=payload,
         signature=signature,
     )
+
+
+def _parse_catchup(tag: int, body: bytes) -> Payload:
+    """Extension slots: TLV body in field 1, no type enum."""
+    tlv = b""
+    o = 0
+    while o < len(body):
+        key, o = _read_varint(body, o)
+        ftag, wt = key >> 3, key & 7
+        if wt != _WT_LEN:
+            raise ValueError(f"unexpected wire type {wt} in Catchup")
+        ln, o = _read_varint(body, o)
+        if ln > MAX_PB_FIELD or o + ln > len(body):
+            raise ValueError("truncated/oversized pb field")
+        if ftag == 1:
+            tlv = body[o : o + ln]
+        o += ln
+    kind = (
+        _KIND_CATCHUP_REQ
+        if tag == _PB_TAG_CATCHUP_REQ
+        else _KIND_CATCHUP_RESP
+    )
+    return _decode_payload(kind, tlv)
 
 
 def _parse_inner(tag: int, body: bytes) -> Payload:

@@ -33,6 +33,14 @@ class Coalition:
         self._stages: List[Callable] = []
         self._captured: List[bytes] = []
         self._capture_cap = 4096
+        # delay stage state: filter-call clock + held frames
+        # (release_at, sender, receiver, frame), release bounded so a
+        # pathological build-up cannot grow without bound
+        self._calls = 0
+        self._held: List[tuple] = []
+        self._held_cap = 4096
+        self.held_total = 0  # observability: frames ever delayed
+        self.released_total = 0
 
     # -- builders ----------------------------------------------------------
 
@@ -69,6 +77,53 @@ class Coalition:
         self._stages.append(stage)
         return self
 
+    def delay(self, fraction: float, hold: int = 16) -> "Coalition":
+        """Hold a fraction of the coalition's frames and release them
+        much later: a held frame re-enters delivery on the first
+        ``filter`` call for the SAME (sender, receiver) pair at least
+        ``hold`` filter calls in the future (pairwise envelope MACs
+        make cross-pair release pointless — the receiver would just
+        reject the frame).  Releases ride the filter-call clock, not
+        wall time, so seeded runs replay exactly.  Frames whose pair
+        never speaks again within the run simply stay held — in an
+        asynchronous network an arbitrarily-delayed frame and a lost
+        frame are indistinguishable."""
+
+        def stage(sender, receiver, frames):
+            out = []
+            for f in frames:
+                if self._rng.random() < fraction and (
+                    len(self._held) < self._held_cap
+                ):
+                    self._held.append(
+                        (self._calls + hold, sender, receiver, f)
+                    )
+                    self.held_total += 1
+                else:
+                    out.append(f)
+            return out
+
+        self._stages.append(stage)
+        return self
+
+    def _release_matured(self, sender: str, receiver: str) -> List[bytes]:
+        """Held frames for this (sender, receiver) pair whose clock
+        matured; removed from the hold queue."""
+        if not self._held:
+            return []
+        out: List[bytes] = []
+        kept: List[tuple] = []
+        for item in self._held:
+            release_at, s, r, f = item
+            if s == sender and r == receiver and release_at <= self._calls:
+                out.append(f)
+            else:
+                kept.append(item)
+        if out:
+            self._held = kept
+            self.released_total += len(out)
+        return out
+
     def replay(self, fraction: float) -> "Coalition":
         """Re-inject previously captured (any-sender) frames alongside
         the coalition's own traffic."""
@@ -86,6 +141,7 @@ class Coalition:
 
     def filter(self, sender: str, receiver: str, wire: bytes):
         # capture everything (for replay), mutate only coalition traffic
+        self._calls += 1
         if len(self._captured) < self._capture_cap:
             self._captured.append(wire)
         if sender not in self.members:
@@ -94,7 +150,12 @@ class Coalition:
         for stage in self._stages:
             frames = stage(sender, receiver, frames)
             if not frames:
-                return None
+                break
+        # matured delayed frames for this pair rejoin delivery even if
+        # the current frame itself was dropped/held
+        frames = list(frames) + self._release_matured(sender, receiver)
+        if not frames:
+            return None
         return frames
 
 

@@ -14,7 +14,7 @@ import bisect
 import collections
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 
 class Counter:
@@ -126,6 +126,15 @@ class Metrics:
         self._trace_cap = trace_cap
         self._t0 = time.monotonic()
         self._lock = threading.Lock()
+        # transport-health provider (transport.health.PeerHealthTracker
+        # .snapshot, set by the host that owns the dial layer): folds a
+        # per-peer UP/DEGRADED/DOWN block into snapshot()
+        self._transport_health: Optional[Callable[[], Dict]] = None
+
+    def set_transport_health(
+        self, provider: Optional[Callable[[], Dict]]
+    ) -> None:
+        self._transport_health = provider
 
     def trace(self, epoch: int) -> EpochTrace:
         with self._lock:
@@ -161,8 +170,10 @@ class Metrics:
         return self.txs_committed.value / dt if dt > 0 else 0.0
 
     def snapshot(self) -> Dict[str, object]:
-        """One flat dict for logging/export (the BASELINE metrics)."""
-        return {
+        """One flat dict for logging/export (the BASELINE metrics),
+        plus the transport-health block when a dial layer registered
+        its provider."""
+        out: Dict[str, object] = {
             "msgs_in": self.msgs_in.value,
             "msgs_out": self.msgs_out.value,
             "epochs_committed": self.epochs_committed.value,
@@ -173,6 +184,9 @@ class Metrics:
             "acs_p50_s": self.acs_latency.p50,
             "decrypt_p50_s": self.decrypt_latency.p50,
         }
+        if self._transport_health is not None:
+            out["transport_health"] = self._transport_health()
+        return out
 
 
 __all__ = ["Counter", "Histogram", "EpochTrace", "Metrics"]

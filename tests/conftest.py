@@ -42,3 +42,9 @@ def pytest_configure(config):
         "slow: multi-minute scale tests (full-protocol N>=64 epochs); "
         "deselect with -m 'not slow'",
     )
+    config.addinivalue_line(
+        "markers",
+        "faults: crash/partition/Byzantine-adversary suite — the ci.sh "
+        "fault-regression gate runs it over a fixed seed matrix "
+        "(FAULT_SEED env selects the scheduler/coalition seed)",
+    )

@@ -3,7 +3,14 @@ traffic is dropped, tampered, duplicated and replayed must never break
 agreement or (with reliable honest channels) liveness.
 
 These are the adversarial-scheduler + fault-injection tests SURVEY.md
-§4/§5.3 calls for, at network scale."""
+§4/§5.3 calls for, at network scale.
+
+The whole module carries the ``faults`` marker: ci.sh's fault-
+regression stage replays it (plus the marked gRPC/transport fault
+tests) over a fixed seed matrix, with ``FAULT_SEED`` selecting the
+scheduler/coalition seed for the seed-parametrized scenarios."""
+
+import os
 
 import pytest
 
@@ -12,6 +19,15 @@ from tests.test_honeybadger import (
     assert_identical_batches,
     make_hb_network,
     push_txs,
+)
+
+pytestmark = pytest.mark.faults
+
+# the ci.sh fault gate exports one seed per stage run; a plain pytest
+# run uses the default
+FAULT_SEEDS = tuple(
+    int(s)
+    for s in os.environ.get("FAULT_SEED", "11").replace(",", " ").split()
 )
 
 
@@ -288,6 +304,114 @@ def test_byzantine_seeded_sweep():
             for b in next(iter(honest.values())).committed_batches
         )
         assert committed > 0, f"no progress at seed {seed} (bad={bad})"
+
+
+@pytest.mark.parametrize("seed", [21, 31])
+def test_byzantine_delayed_frames_released_much_later(seed):
+    """A coalition that HOLDS its frames and releases them many filter
+    calls later (Coalition.delay) must not break agreement: a delayed
+    frame is just an adversarial asynchronous schedule, and per-sender
+    dedup absorbs stale arrivals."""
+    cfg, net, nodes = make_hb_network(4, batch_size=8, seed=seed, auth=True)
+    bad = "node2"
+    coal = Coalition([bad], seed=seed).delay(0.3, hold=40)
+    net.fault_filter = coal.filter
+    push_txs(nodes, 12)
+    run_epochs(net, nodes)
+    assert_identical_batches(nodes)
+    assert coal.held_total > 0  # the stage actually held frames
+    assert coal.released_total > 0  # ...and released some much later
+
+
+@pytest.mark.parametrize("seed", FAULT_SEEDS)
+def test_crash_restart_wal_catchup_under_byzantine_coalition(
+    tmp_path, seed
+):
+    """The crash-recovery acceptance scenario: a network with one
+    Byzantine member (drop 0.3 + replay 0.2) commits epochs; an HONEST
+    node then fail-stops, the survivors keep committing, and a fresh
+    process restarted from the victim's WAL rejoins via CATCHUP —
+    converging to byte-identical committed batches for every common
+    epoch, including the epochs it was down for.
+
+    Roster arithmetic: the down phase carries TWO simultaneous faults
+    (the drop-lossy Byzantine member + the crashed honest node), so it
+    needs f >= 2 — at n=4/f=1 the survivors' quorum is exactly the
+    three live nodes including the lossy one, and a dropped frame
+    wedges the wave forever (frame drops have no retransmission; a
+    quiescent epoch is absorbing).  n=7/f=2 keeps the scenario inside
+    the fault budget, which is what HBBFT actually promises."""
+    from cleisthenes_tpu.config import Config
+    from cleisthenes_tpu.core.ledger import BatchLog, encode_batch_body
+    from cleisthenes_tpu.protocol.honeybadger import HoneyBadger, setup_keys
+    from cleisthenes_tpu.transport.base import HmacAuthenticator
+    from cleisthenes_tpu.transport.broadcast import ChannelBroadcaster
+    from cleisthenes_tpu.transport.channel import ChannelNetwork
+
+    cfg = Config(n=7, batch_size=8)
+    ids = [f"node{i}" for i in range(7)]
+    keys = setup_keys(cfg, ids, seed=33)
+    net = ChannelNetwork(seed=seed)
+    bad = "node6"
+    net.fault_filter = (
+        Coalition([bad], seed=seed).drop(0.3).replay(0.2).filter
+    )
+    victim = "node1"
+
+    def build(node_id, log):
+        return HoneyBadger(
+            config=cfg,
+            node_id=node_id,
+            member_ids=ids,
+            keys=keys[node_id],
+            out=ChannelBroadcaster(net, node_id, ids),
+            batch_log=log,
+        )
+
+    nodes = {}
+    for nid in ids:
+        log = (
+            BatchLog(str(tmp_path / f"{nid}.log")) if nid == victim else None
+        )
+        nodes[nid] = build(nid, log)
+        net.join(nid, nodes[nid], HmacAuthenticator(nid, keys[nid].mac_keys))
+
+    push_txs(nodes, 14, prefix=b"pre")
+    run_epochs(net, nodes)
+    k = assert_identical_batches(nodes)
+    assert k >= 1  # the victim crashes AFTER epoch k-1 committed
+
+    # fail-stop: in-flight frames die with the process; the WAL survives
+    net.crash(victim)
+    nodes[victim].batch_log.close()
+    survivors = {n: h for n, h in nodes.items() if n != victim}
+    push_txs(survivors, 14, prefix=b"down")
+    run_epochs(net, survivors)
+    down_depth = assert_identical_batches(survivors)
+    assert down_depth > k  # epochs committed WHILE the victim was down
+
+    # restart: fresh process, same identity/keys, state from the WAL
+    fresh = build(victim, BatchLog(str(tmp_path / f"{victim}.log")))
+    assert fresh.epoch >= k  # resumed from the log, not from epoch 0
+    net.restart(
+        victim, fresh, HmacAuthenticator(victim, keys[victim].mac_keys)
+    )
+    nodes[victim] = fresh
+    fresh.request_catchup()
+    net.run()
+    # rejoin the live protocol for one more joint wave
+    push_txs(nodes, 8, prefix=b"post")
+    run_epochs(net, nodes)
+    depth = assert_identical_batches(nodes)
+    assert depth >= down_depth  # caught up through its whole outage
+    # byte-identical committed batches (ledger-body bytes) everywhere,
+    # down epochs included
+    for e in range(depth):
+        want = encode_batch_body(e, nodes["node0"].committed_batches[e])
+        for nid in ids:
+            got = encode_batch_body(e, nodes[nid].committed_batches[e])
+            assert got == want, f"epoch {e}: {nid} bytes differ"
+    fresh.batch_log.close()
 
 
 def test_byzantine_duplicate_index_dec_share_does_not_stall():

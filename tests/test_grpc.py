@@ -235,6 +235,113 @@ def test_broadcaster_buffers_until_ready():
     disp.stop()
 
 
+@pytest.mark.faults
+def test_host_crash_restart_catchup_with_backoff(tmp_path):
+    """Crash recovery over real sockets: a host with a durable batch
+    log stops mid-roster, the survivors commit an epoch without it,
+    and a FRESH host restarted from the WAL on the same address
+    rejoins, catches up via CATCHUP, and converges to the survivors'
+    batches.  Meanwhile the survivors' redial loops must back off
+    exponentially — growing delays in the health tracker's reconnect
+    counters, not fixed-interval spinning."""
+    n = 4
+    cfg = Config(
+        n=n,
+        batch_size=8,
+        seed=7,  # seeds the dial-jitter rng: replayable schedule
+        dial_timeout_s=0.25,
+        dial_retry_base_s=0.05,
+        dial_retry_max_s=1.0,
+    )
+    ids = [f"node{i}" for i in range(n)]
+    keys = setup_keys(cfg, ids, seed=77)
+    victim = "node3"
+    wal = str(tmp_path / "node3.log")
+    hosts = {
+        i: ValidatorHost(
+            cfg, i, ids, keys[i],
+            batch_log_path=wal if i == victim else None,
+        )
+        for i in ids
+    }
+    restarted = None
+    try:
+        addrs = {i: h.listen() for i, h in hosts.items()}
+        threads = [
+            threading.Thread(target=h.connect, args=(addrs,))
+            for h in hosts.values()
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=15)
+        # epoch 0 commits everywhere (the victim logs it durably)
+        for i, tx in enumerate([b"pre-%02d" % i for i in range(8)]):
+            hosts[ids[i % n]].submit(tx)
+        for h in hosts.values():
+            h.propose()
+        for h in hosts.values():
+            h.wait_commit(timeout=60)
+        # fail-stop the victim; survivors' redial loops start backing off
+        hosts[victim].stop()
+        survivors = {i: h for i, h in hosts.items() if i != victim}
+        # n=4 tolerates the single crash: epoch 1 commits without it
+        for i, tx in enumerate([b"down-%02d" % i for i in range(9)]):
+            survivors[ids[i % 3]].submit(tx)
+        for h in survivors.values():
+            h.propose()
+        commits = {i: h.wait_commit(timeout=60) for i, h in survivors.items()}
+        lists = [b.tx_list() for _, b in commits.values()]
+        assert all(l == lists[0] for l in lists) and lists[0]
+        time.sleep(1.0)  # let several redial attempts record their delays
+        # restart from the WAL: same identity, same address, new process
+        restarted = ValidatorHost(
+            cfg, victim, ids, keys[victim],
+            listen_addr=addrs[victim],
+            batch_log_path=wal,
+        )
+        assert restarted.node.epoch == 1  # epoch 0 replayed from the WAL
+        got = restarted.listen()
+        assert got == addrs[victim]
+        restarted.connect(addrs)  # fires the CATCHUP request
+        # NO manual re-kicking: if a survivor's redial to us had not
+        # healed when our CatchupReq arrived, its responses went into
+        # the void — the heal event (peer_reconnected) must re-serve
+        # our window on its own
+        want = survivors[ids[0]].committed_batches()
+        deadline = time.monotonic() + 30
+        caught_up = None
+        while time.monotonic() < deadline:
+            caught_up = restarted.committed_batches()
+            if len(caught_up) >= len(want):
+                break
+            time.sleep(0.25)
+        assert caught_up is not None and len(caught_up) >= len(want)
+        for e, batch in enumerate(want):
+            assert caught_up[e].tx_list() == batch.tx_list()
+        # backoff evidence: a survivor reconnected to the victim, and
+        # its scheduled redial delays GREW (factor 2, jitter +/-25%:
+        # each pre-cap delay strictly exceeds the previous one)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            snap = survivors[ids[0]].health.snapshot()[victim]
+            if snap["state"] == "up" and snap["reconnects"] >= 1:
+                break
+            time.sleep(0.05)
+        assert snap["reconnects"] >= 1, snap
+        delays = snap["recent_delays_s"]
+        assert len(delays) >= 2, snap
+        pre_cap = [d for d in delays if d < cfg.dial_retry_max_s * 0.75]
+        assert all(b > a for a, b in zip(pre_cap, pre_cap[1:])), delays
+        assert max(delays) > cfg.dial_retry_base_s * 1.25, delays
+    finally:
+        for h in hosts.values():
+            h.stop()  # double-stop of the victim is a no-op
+        if restarted is not None:
+            restarted.stop()
+
+
+@pytest.mark.faults
 def test_host_redials_lost_peer_stream():
     """A severed peer stream re-establishes via the host's backoff
     redial loop, and the protocol commits a later epoch through the

@@ -69,6 +69,99 @@ def test_log_rejects_corrupt_crc(tmp_path):
     log2.close()
 
 
+def test_checkpoint_roundtrip_and_replay_skips_it(tmp_path):
+    path = str(tmp_path / "batches.log")
+    log = BatchLog(path)
+    log.append(0, _batch(("a", [b"t1"])))
+    log.append(1, _batch(("b", [b"t2", b"t3"])))
+    log.append_checkpoint(1, [{b"t1"}, {b"t2", b"t3"}])
+    log.append(2, _batch(("a", [b"t4"])))
+    log.close()
+
+    log2 = BatchLog(path)
+    # batch replay is unchanged by the interleaved checkpoint
+    assert [e for e, _ in log2.replay()] == [0, 1, 2]
+    assert log2.last_epoch == 2
+    epoch, history = log2.last_checkpoint
+    assert epoch == 1
+    assert history == [{b"t1"}, {b"t2", b"t3"}]
+    log2.close()
+
+
+def test_torn_checkpoint_truncated_like_torn_batch(tmp_path):
+    from cleisthenes_tpu.core.ledger import (
+        _encode_checkpoint_body,
+        _frame_record,
+        _MAGIC_CKPT,
+    )
+
+    path = str(tmp_path / "batches.log")
+    log = BatchLog(path)
+    log.append(0, _batch(("a", [b"x"])))
+    log.append_checkpoint(0, [{b"x"}])
+    log.close()
+    rec = _frame_record(_MAGIC_CKPT, _encode_checkpoint_body(1, [{b"y"}]))
+    with open(path, "ab") as fh:
+        fh.write(rec[: len(rec) // 2])  # crash mid-checkpoint
+    log2 = BatchLog(path)
+    assert log2.last_epoch == 0
+    assert log2.last_checkpoint == (0, [{b"x"}])
+    log2.close()
+
+
+def test_restart_seeds_filter_from_checkpoint(tmp_path):
+    """A restarted node whose log carries a checkpoint must restore
+    the SAME duplicate filter the pre-crash node held — without
+    re-deriving tx sets from the batches the checkpoint covers."""
+    from cleisthenes_tpu.config import Config
+    from cleisthenes_tpu.protocol.honeybadger import HoneyBadger, setup_keys
+    from cleisthenes_tpu.transport.broadcast import ChannelBroadcaster
+    from cleisthenes_tpu.transport.channel import ChannelNetwork
+
+    cfg = Config(n=4, batch_size=8, ledger_checkpoint_every=2)
+    ids = [f"node{i}" for i in range(4)]
+    keys = setup_keys(cfg, ids, seed=77)
+    logdir = tmp_path / "ckpt-logs"
+    os.makedirs(logdir)
+
+    def build(net):
+        nodes = {}
+        for node_id in ids:
+            nodes[node_id] = HoneyBadger(
+                config=cfg,
+                node_id=node_id,
+                member_ids=ids,
+                keys=keys[node_id],
+                out=ChannelBroadcaster(net, node_id, ids),
+                batch_log=BatchLog(str(logdir / f"{node_id}.log")),
+            )
+            net.join(node_id, nodes[node_id], None)
+        return nodes
+
+    net = ChannelNetwork()
+    nodes = build(net)
+    push_txs(nodes, 24, prefix=b"ck")
+    for _ in range(10):
+        for hb in nodes.values():
+            hb.start_epoch()
+        net.run()
+        if all(hb.pending_tx_count() == 0 for hb in nodes.values()):
+            break
+    depth = assert_identical_batches(nodes)
+    assert depth >= 2
+    # every-2-commits policy actually wrote checkpoints
+    assert nodes["node0"].batch_log.last_checkpoint is not None
+    filters = {nid: set(hb._committed_filter) for nid, hb in nodes.items()}
+    for hb in nodes.values():
+        hb.batch_log.close()
+
+    net2 = ChannelNetwork()
+    nodes2 = build(net2)
+    for nid, hb in nodes2.items():
+        assert hb.epoch == len(hb.committed_batches)
+        assert set(hb._committed_filter) == filters[nid]
+
+
 def test_node_restart_resumes_epoch_and_filter(tmp_path):
     """A validator restarted from its log continues at last_epoch+1
     with its committed history and duplicate filter restored."""
@@ -139,10 +232,12 @@ def test_node_restart_resumes_epoch_and_filter(tmp_path):
     assert new_txs  # run2 actually committed something
 
 
-def test_lagging_restart_catches_up_via_state_sync(tmp_path):
+def test_lagging_restart_catches_up_via_catchup(tmp_path):
     """A node restarted with a stale log (missing epochs the cluster
     already committed) must adopt the missing batches via f+1 matching
-    sync responses, not stall or fork."""
+    CATCHUP responses, not stall or fork — and recover the whole
+    outage window from ONE request round (range serving), not one
+    request per epoch."""
     from cleisthenes_tpu.protocol.honeybadger import HoneyBadger, setup_keys
     from cleisthenes_tpu.config import Config
     from cleisthenes_tpu.transport.broadcast import ChannelBroadcaster
@@ -191,7 +286,7 @@ def test_lagging_restart_catches_up_via_state_sync(tmp_path):
         inner._network = net2
     fresh = build(net2, "node3")
     assert fresh.epoch == 0
-    fresh.request_sync()
+    fresh.request_catchup()
     net2.run()
     assert fresh.epoch >= depth  # caught up past the common depth
     for e in range(depth):
@@ -201,16 +296,16 @@ def test_lagging_restart_catches_up_via_state_sync(tmp_path):
         )
 
 
-def test_state_sync_rejects_forged_minority(tmp_path):
-    """f forged sync responses must not fool a syncing node: adoption
-    needs f+1 identical bodies."""
+def test_catchup_rejects_forged_minority(tmp_path):
+    """f forged catch-up responses must not fool a syncing node:
+    adoption needs f+1 identical bodies."""
     from cleisthenes_tpu.core.ledger import encode_batch_body
     from cleisthenes_tpu.core.batch import Batch
     from cleisthenes_tpu.protocol.honeybadger import HoneyBadger, setup_keys
     from cleisthenes_tpu.config import Config
     from cleisthenes_tpu.transport.broadcast import ChannelBroadcaster
     from cleisthenes_tpu.transport.channel import ChannelNetwork
-    from cleisthenes_tpu.transport.message import SyncResponsePayload
+    from cleisthenes_tpu.transport.message import CatchupRespPayload
 
     cfg = Config(n=4, batch_size=8)
     ids = [f"node{i}" for i in range(4)]
@@ -229,11 +324,11 @@ def test_state_sync_rejects_forged_minority(tmp_path):
         0, Batch(contributions={"node0": [b"EVIL-TX"]})
     )
     # one Byzantine response (f=1): must NOT be adopted
-    hb._handle_sync_response("node0", SyncResponsePayload(0, forged))
+    hb._handle_catchup_resp("node0", CatchupRespPayload(0, forged))
     assert hb.epoch == 0 and not hb.committed_batches
     # a second matching response crosses f+1 and is adopted (by design:
     # two senders => at least one honest in the threat model)
-    hb._handle_sync_response("node1", SyncResponsePayload(0, forged))
+    hb._handle_catchup_resp("node1", CatchupRespPayload(0, forged))
     assert hb.epoch == 1
     # duplicate/overwrite from the same sender never double-counts
     hb2 = HoneyBadger(
@@ -244,6 +339,93 @@ def test_state_sync_rejects_forged_minority(tmp_path):
         out=ChannelBroadcaster(net, "node2", ids),
     )
     net.join("node2", hb2, None)
-    hb2._handle_sync_response("node0", SyncResponsePayload(0, forged))
-    hb2._handle_sync_response("node0", SyncResponsePayload(0, forged))
+    hb2._handle_catchup_resp("node0", CatchupRespPayload(0, forged))
+    hb2._handle_catchup_resp("node0", CatchupRespPayload(0, forged))
     assert hb2.epoch == 0 and not hb2.committed_batches
+
+
+def _bare_hb(node_id="node3", seed=93):
+    from cleisthenes_tpu.config import Config
+    from cleisthenes_tpu.protocol.honeybadger import HoneyBadger, setup_keys
+    from cleisthenes_tpu.transport.broadcast import ChannelBroadcaster
+    from cleisthenes_tpu.transport.channel import ChannelNetwork
+
+    cfg = Config(n=4, batch_size=8)
+    ids = [f"node{i}" for i in range(4)]
+    keys = setup_keys(cfg, ids, seed=seed)
+    net = ChannelNetwork()
+    hb = HoneyBadger(
+        config=cfg,
+        node_id=node_id,
+        member_ids=ids,
+        keys=keys[node_id],
+        out=ChannelBroadcaster(net, node_id, ids),
+    )
+    net.join(node_id, hb, None)
+    return hb
+
+
+def test_catchup_chase_not_suppressed_by_subquorum_tally():
+    """Liveness regression: after adopting a window, a SUB-quorum (or
+    Byzantine) tally already sitting at the new frontier must not
+    suppress the follow-up CatchupReq — one dropped response would
+    otherwise wedge the catch-up forever in a quiescent cluster."""
+    from cleisthenes_tpu.core.ledger import encode_batch_body
+    from cleisthenes_tpu.core.batch import Batch
+    from cleisthenes_tpu.transport.message import CatchupRespPayload
+
+    hb = _bare_hb()
+    body0 = encode_batch_body(0, Batch(contributions={"node0": [b"a"]}))
+    body1 = encode_batch_body(1, Batch(contributions={"node0": [b"b"]}))
+    # a lone epoch-1 response arrives first (sub-quorum at the future
+    # frontier), then epoch 0 reaches its f+1 quorum
+    hb._handle_catchup_resp("node0", CatchupRespPayload(1, body1))
+    hb._handle_catchup_resp("node0", CatchupRespPayload(0, body0))
+    hb._handle_catchup_resp("node1", CatchupRespPayload(0, body0))
+    assert hb.epoch == 1  # epoch 0 adopted
+    # the chase fired at the new frontier despite the epoch-1 tally
+    assert hb._last_catchup_request == 1
+
+
+def test_catchup_serving_rate_limited_and_reserved_on_heal():
+    """Amplification guard: a request whose from_epoch does not
+    advance past the window already served draws from a small repeat
+    budget (counted, never clocked — seeded runs replay exactly), so
+    an 8-byte CatchupReq cannot buy unlimited 32-batch response
+    windows; a link-heal event (peer_reconnected) re-arms the budget
+    and re-serves the sender's last window."""
+    from cleisthenes_tpu.core.batch import Batch
+    from cleisthenes_tpu.protocol.honeybadger import CATCHUP_REPEAT_BUDGET
+    from cleisthenes_tpu.transport.message import CatchupReqPayload
+
+    hb = _bare_hb()
+    hb.committed_batches.extend(
+        [Batch(contributions={"node0": [b"e%d" % e]}) for e in range(2)]
+    )
+    out0 = hb.metrics.msgs_out.value
+    hb._handle_catchup_req("node0", CatchupReqPayload(0))
+    served = hb.metrics.msgs_out.value - out0
+    assert served == 2  # both epochs served in one window
+    # non-advancing replays drain the repeat budget, then are refused
+    for i in range(CATCHUP_REPEAT_BUDGET):
+        hb._handle_catchup_req("node0", CatchupReqPayload(0))
+        assert hb.metrics.msgs_out.value - out0 == (i + 2) * served
+    hb._handle_catchup_req("node0", CatchupReqPayload(0))
+    hb._handle_catchup_req("node0", CatchupReqPayload(0))
+    assert (
+        hb.metrics.msgs_out.value - out0
+        == (CATCHUP_REPEAT_BUDGET + 1) * served
+    )
+    # other senders have their own budget
+    out1 = hb.metrics.msgs_out.value
+    hb._handle_catchup_req("node1", CatchupReqPayload(0))
+    assert hb.metrics.msgs_out.value - out1 == served
+    # the transport's link-heal event re-arms node0 and re-serves its
+    # last requested window (responses sent into a dead link are gone)
+    out2 = hb.metrics.msgs_out.value
+    hb.peer_reconnected("node0")
+    assert hb.metrics.msgs_out.value - out2 == served
+    # a non-member heal event is ignored
+    out3 = hb.metrics.msgs_out.value
+    hb.peer_reconnected("intruder")
+    assert hb.metrics.msgs_out.value == out3

@@ -13,10 +13,12 @@ import pytest
 from cleisthenes_tpu.transport.message import (
     BbaPayload,
     BbaType,
+    CatchupReqPayload,
+    CatchupRespPayload,
+    CoinPayload,
     Message,
     RbcPayload,
     RbcType,
-    SyncRequestPayload,
 )
 from cleisthenes_tpu.transport.pb_adapter import (
     decode_pb_message,
@@ -54,10 +56,34 @@ def test_roundtrip(payload):
 
 def test_non_reference_payloads_have_no_slot():
     msg = Message(
-        sender_id="x", timestamp=0.0, payload=SyncRequestPayload(epoch=1)
+        sender_id="x",
+        timestamp=0.0,
+        payload=CoinPayload("p", 1, 0, 1, 7, 8, 9),
     )
     with pytest.raises(ValueError, match="no slot"):
         encode_pb_message(msg)
+
+
+@pytest.mark.parametrize(
+    "payload",
+    [
+        CatchupReqPayload(from_epoch=9),
+        CatchupRespPayload(epoch=4, body=b"ledger-body-bytes"),
+    ],
+)
+def test_catchup_extension_slots_roundtrip(payload):
+    """The crash-recovery CATCHUP pair rides extension tags beyond the
+    reference's oneof and round-trips byte-exactly; a stock decoder of
+    the unextended schema skips them as unknown fields."""
+    msg = Message(
+        sender_id="node9",
+        timestamp=55.25,
+        payload=payload,
+        signature=b"\x02" * 32,
+    )
+    back = decode_pb_message(encode_pb_message(msg), sender_id="node9")
+    assert back.payload == payload
+    assert back.signature == msg.signature
 
 
 def test_malformed_frames_rejected():

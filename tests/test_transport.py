@@ -247,6 +247,28 @@ class TestChannelNetwork:
         assert col["n1"].got == []
         assert len(col["n2"].got) == 1
 
+    @pytest.mark.faults
+    def test_crash_purges_inflight_and_restart_gets_fresh_inbox(self):
+        """Fail-stop semantics: frames in flight to/from the node die
+        with it, so a restart() cannot see pre-crash ghosts — it
+        rejoins with a NEW handler and an empty inbox."""
+        net, col = _mk_net(3)
+        net.connect("n0", "n1").send(_msg("n0", epoch=1))
+        net.connect("n1", "n2").send(_msg("n1", epoch=2))
+        net.crash("n1")  # both in-flight frames involve n1: purged
+        assert net.pending_count() == 0
+        net.run()
+        assert col["n1"].got == [] and col["n2"].got == []
+        fresh = _Collector()
+        net.restart("n1", fresh)
+        net.connect("n0", "n1").send(_msg("n0", epoch=3))
+        net.connect("n1", "n2").send(_msg("n1", epoch=4))
+        net.run()
+        # the restarted handler (not the old one) receives new traffic
+        assert [m.payload.epoch for m in fresh.got] == [3]
+        assert col["n1"].got == []
+        assert [m.payload.epoch for m in col["n2"].got] == [4]
+
     def test_partition_and_heal(self):
         net, col = _mk_net()
         net.partition("n0", "n1")
@@ -320,6 +342,8 @@ def test_codec_fuzz_never_crashes():
         BbaPayload,
         BbaType,
         BundlePayload,
+        CatchupReqPayload,
+        CatchupRespPayload,
         CoinBatchPayload,
         CoinPayload,
         DecShareBatchPayload,
@@ -329,8 +353,6 @@ def test_codec_fuzz_never_crashes():
         RbcPayload,
         RbcType,
         ReadyBatchPayload,
-        SyncRequestPayload,
-        SyncResponsePayload,
         decode_frame,
         encode_message,
     )
@@ -347,8 +369,8 @@ def test_codec_fuzz_never_crashes():
                     BbaPayload(BbaType.BVAL, "p", 1, 0, True),
                     CoinPayload("p", 1, 0, 1, 7, 8, 9),
                     DecSharePayload("p", 1, 1, 7, 8, 9),
-                    SyncRequestPayload(1),
-                    SyncResponsePayload(1, b"body"),
+                    CatchupReqPayload(1),
+                    CatchupRespPayload(1, b"body"),
                     BbaBatchPayload(BbaType.BVAL, 1, 0, True, ("a", "b")),
                     CoinBatchPayload(1, 0, 2, ("a", "b"), (1, 2), (3, 4),
                                      (5, 6)),
