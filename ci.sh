@@ -9,19 +9,26 @@
 #      image bakes no third-party formatter; the gate enforces this
 #      tree's deterministic style invariants — parseability, LF, EOF
 #      newline, no tabs/trailing whitespace, <= 99 cols — stdlib-only)
-#   2. fast test tier      — pytest minus the multi-minute scale
+#   2. staticcheck gate    — tools/staticcheck: the determinism-plane
+#      AST analyzer (DET001 wall clocks/entropy, DET002 set-iteration
+#      hash order, CONC001 @guarded_by lock discipline, CONC002
+#      blocking calls in handlers, ERR001 swallowed exceptions).
+#      Fails on ANY unbaselined finding; the committed baseline is
+#      empty — every sanctioned exception is a justified pragma.
+#      Sub-second and stdlib-only, so CI_FAST runs it too.
+#   3. fast test tier      — pytest minus the multi-minute scale
 #      tests, under tools/covgate.py (PEP 669 line coverage; the
 #      tier must execute >= 85% of the package's executable lines —
 #      the travis pipeline's coverage upload, translated to a GATE)
-#   3. race-analog tier    — the seeded deterministic-scheduler suites
+#   4. race-analog tier    — the seeded deterministic-scheduler suites
 #      (transport/byzantine), this stack's answer to `-race`
 #      (SURVEY.md §5.2: replayable interleavings instead of a dynamic
 #      race detector), plus the real-thread gRPC suite
-#   4. fault tier          — the crash/partition/adversary suite
+#   5. fault tier          — the crash/partition/adversary suite
 #      (`-m faults`: Byzantine coalitions, crash+WAL-restart+CATCHUP,
 #      gRPC backoff redial) replayed over a fixed 3-seed matrix, so a
 #      fault-handling regression on ANY matrix seed gates the merge
-#   5. full tier           — everything, including the N=64 slow test
+#   6. full tier           — everything, including the N=64 slow test
 #      (skipped when CI_FAST=1)
 #
 # Usage:  ./ci.sh          # full gate
@@ -30,21 +37,24 @@
 set -euo pipefail
 cd "$(dirname "$0")"
 
-echo "== [1/5] syntax + format gate"
+echo "== [1/6] syntax + format gate"
 python -m compileall -q cleisthenes_tpu tests bench.py __graft_entry__.py
 python tools/format_gate.py
 
-echo "== [2/5] fast tests (with coverage gate)"
+echo "== [2/6] staticcheck gate: determinism plane + lock discipline"
+python -m tools.staticcheck cleisthenes_tpu
+
+echo "== [3/6] fast tests (with coverage gate)"
 COVGATE_MIN="${COVGATE_MIN:-85}" \
     python -m pytest tests/ -q -m "not slow" -x -p tools.covgate
 
-echo "== [3/5] race-analog: seeded-scheduler + threaded-transport suites"
+echo "== [4/6] race-analog: seeded-scheduler + threaded-transport suites"
 python -m pytest tests/test_transport.py tests/test_byzantine.py \
     tests/test_grpc.py -q -x
 
-echo "== [4/5] fault gate: crash/partition/adversary suite, 3-seed matrix"
+echo "== [5/6] fault gate: crash/partition/adversary suite, 3-seed matrix"
 # the full faults-marked suite already ran at the default seed in
-# stages 2-3; the matrix replays the FAULT_SEED-parametrized
+# stages 3-4; the matrix replays the FAULT_SEED-parametrized
 # crash+WAL-restart+CATCHUP scenario (the seed-sensitive entry point)
 # at every matrix seed, so a fault regression on ANY seed gates
 for seed in 11 23 47; do
@@ -54,9 +64,9 @@ for seed in 11 23 47; do
 done
 
 if [[ "${CI_FAST:-0}" == "1" ]]; then
-    echo "== [5/5] skipped (CI_FAST=1)"
+    echo "== [6/6] skipped (CI_FAST=1)"
 else
-    echo "== [5/5] full suite incl. scale tests"
+    echo "== [6/6] full suite incl. scale tests"
     python -m pytest tests/ -q -m slow
 fi
 

@@ -37,6 +37,7 @@ import zlib
 from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
 
 from cleisthenes_tpu.core.batch import Batch
+from cleisthenes_tpu.utils.determinism import guarded_by
 
 _MAGIC = b"CLOG"
 _MAGIC_CKPT = b"CCKP"
@@ -150,8 +151,14 @@ def _decode_body(body: bytes) -> Tuple[int, Batch]:
     return epoch, Batch(contributions=contributions)
 
 
+@guarded_by("_lock", "_fh", "_last_epoch", "_last_checkpoint")
 class BatchLog:
-    """Append-only durable log of committed batches."""
+    """Append-only durable log of committed batches.
+
+    One lock guards the file handle and the recovered-state fields
+    (commit path and CATCHUP serving run on different threads under
+    the gRPC transport); ``*_locked`` methods assume the caller —
+    or single-threaded construction — already holds exclusivity."""
 
     def __init__(self, path: str, fsync: bool = False):
         self.path = path
@@ -159,7 +166,7 @@ class BatchLog:
         self._lock = threading.Lock()
         self._last_epoch: Optional[int] = None
         self._last_checkpoint: Optional[Tuple[int, List[Set[bytes]]]] = None
-        self._recover()
+        self._recover_locked()
         self._fh = open(path, "ab")
 
     @staticmethod
@@ -191,8 +198,9 @@ class BatchLog:
             yield end, magic, body
             off = end
 
-    def _recover(self) -> None:
-        """Scan the log, truncating any torn tail."""
+    def _recover_locked(self) -> None:
+        """Scan the log, truncating any torn tail (construction-time:
+        the instance is not shared yet)."""
         if not os.path.exists(self.path):
             return
         with open(self.path, "rb") as fh:
@@ -209,7 +217,7 @@ class BatchLog:
             with open(self.path, "r+b") as fh:
                 fh.truncate(good_end)
 
-    def _append_record(self, rec: bytes) -> None:
+    def _append_record_locked(self, rec: bytes) -> None:
         self._fh.write(rec)
         self._fh.flush()
         if self.fsync:
@@ -218,7 +226,7 @@ class BatchLog:
     def append(self, epoch: int, batch: Batch) -> None:
         rec = _encode_record(epoch, batch)
         with self._lock:
-            self._append_record(rec)
+            self._append_record_locked(rec)
             self._last_epoch = epoch
 
     def append_checkpoint(
@@ -231,7 +239,7 @@ class BatchLog:
             _MAGIC_CKPT, _encode_checkpoint_body(epoch, history)
         )
         with self._lock:
-            self._append_record(rec)
+            self._append_record_locked(rec)
             self._last_checkpoint = (epoch, [set(s) for s in history])
 
     def replay(self) -> Iterator[Tuple[int, Batch]]:
@@ -245,13 +253,15 @@ class BatchLog:
 
     @property
     def last_epoch(self) -> Optional[int]:
-        return self._last_epoch
+        with self._lock:
+            return self._last_epoch
 
     @property
     def last_checkpoint(self) -> Optional[Tuple[int, List[Set[bytes]]]]:
         """(epoch, dedup epoch-sets) of the newest checkpoint record,
         or None when the log holds no (intact) checkpoint."""
-        return self._last_checkpoint
+        with self._lock:
+            return self._last_checkpoint
 
     def close(self) -> None:
         with self._lock:

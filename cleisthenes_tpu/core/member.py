@@ -12,6 +12,8 @@ import dataclasses
 import threading
 from typing import Dict, List, Optional
 
+from cleisthenes_tpu.utils.determinism import guarded_by
+
 
 @dataclasses.dataclass(frozen=True, order=True)
 class Address:
@@ -42,6 +44,7 @@ class Member:
         return self.addr
 
 
+@guarded_by("_lock", "_members")
 class MemberMap:
     """Lock-guarded id -> Member map (reference member_map.go:43-87)."""
 

@@ -14,6 +14,8 @@ import collections
 import threading
 from typing import Any, Deque
 
+from cleisthenes_tpu.utils.determinism import guarded_by
+
 # A transaction is opaque to the consensus core (honeybadger.go:115).
 Transaction = Any
 
@@ -34,6 +36,7 @@ class IndexBoundaryError(Exception):
         self.size = size
 
 
+@guarded_by("_lock", "_txs")
 class TxQueue:
     """Thread-safe FIFO of opaque transactions (reference queue.go:15-94)."""
 

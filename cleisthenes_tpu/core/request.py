@@ -13,6 +13,8 @@ from __future__ import annotations
 import threading
 from typing import Any, Dict, List, Tuple
 
+from cleisthenes_tpu.utils.determinism import guarded_by
+
 Request = Any  # marker interface (reference request.go:3-5)
 
 
@@ -24,6 +26,7 @@ class DuplicateRequestError(Exception):
     """
 
 
+@guarded_by("_lock", "_reqs")
 class RequestRepository:
     """Per-connection-id request store (reference request.go:7-11).
 
@@ -58,6 +61,7 @@ class RequestRepository:
             return conn_id in self._reqs
 
 
+@guarded_by("_lock", "_reqs")
 class IncomingRequestRepository:
     """Epoch-keyed buffer for future-epoch messages
     (reference request.go:13-17, bba/request.go:28-32).

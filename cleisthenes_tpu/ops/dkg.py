@@ -91,7 +91,7 @@ def _sample_coeffs(
     q = group.q
     nb = group.nbytes + 8
     if seed is None:
-        rnd = _secrets.token_bytes
+        rnd = _secrets.token_bytes  # staticcheck: allow[DET001] unseeded DKG keygen
     else:
         ctr = [0]
 

@@ -262,7 +262,7 @@ def deal(
             return out[:k]
 
     else:
-        rng_bytes = secrets.token_bytes
+        rng_bytes = secrets.token_bytes  # staticcheck: allow[DET001] unseeded dealer keygen
     # 8 excess bytes: the reduction mod q is statistically unbiased
     # (bias < 2^-64), matching _shamir_shares' rule
     s = int.from_bytes(rng_bytes(group.nbytes + 8), "big") % group.q
@@ -291,10 +291,10 @@ def issue_share(
     # 8 excess bytes -> unbiased nonce: a biased Schnorr/CP nonce
     # leaks the secret share to a lattice (hidden-number) attack over
     # many observed shares, since z = w + e*s_i is linear in w
-    w = (
-        int.from_bytes(secrets.token_bytes(group.nbytes + 8), "big")
-        % group.q
+    nonce = secrets.token_bytes(  # staticcheck: allow[DET001] CP-proof nonce
+        group.nbytes + 8
     )
+    w = int.from_bytes(nonce, "big") % group.q
     a1, a2, hi, d = host_pow_batch(
         [group.g, base, group.g, base],
         [w, w, share.value, share.value],
@@ -343,7 +343,9 @@ def issue_shares_batch(
     # ~N^2 shares; per-item token_bytes was one syscall each), sliced
     # per item — same unbiased nonce rule (and reason) as issue_share
     stride = nbytes + 8
-    nonce_pool = secrets.token_bytes(stride * len(items))
+    nonce_pool = secrets.token_bytes(  # staticcheck: allow[DET001] CP-proof nonces
+        stride * len(items)
+    )
     off = 0
     for share, base, _context, vk in items:
         w = int.from_bytes(nonce_pool[off : off + stride], "big") % q

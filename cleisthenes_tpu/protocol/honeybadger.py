@@ -29,7 +29,6 @@ from __future__ import annotations
 
 import dataclasses
 import hashlib
-import random
 import struct
 from typing import Callable, Dict, List, Optional, Sequence, Set
 
@@ -50,6 +49,7 @@ from cleisthenes_tpu.ops.tpke import (
     issue_shares_batch,
 )
 from cleisthenes_tpu.protocol.acs import ACS
+from cleisthenes_tpu.utils.determinism import proposal_rng
 from cleisthenes_tpu.utils.log import NodeLogger
 from cleisthenes_tpu.utils.metrics import Metrics
 from cleisthenes_tpu.transport.broadcast import CoalescingBroadcaster
@@ -247,7 +247,9 @@ def setup_keys(
     if seed is None:
         import secrets
 
-        mac_master = secrets.token_bytes(32)
+        # the envelope-MAC master MUST be unpredictable; it never
+        # influences protocol scheduling, so it is sanctioned entropy:
+        mac_master = secrets.token_bytes(32)  # staticcheck: allow[DET001] dealer keygen
     else:
         mac_master = b"cleisthenes-tpu-test-mac|%d" % seed
     # dealer-side pairwise key schedule: node i receives ONLY the keys
@@ -428,12 +430,11 @@ class HoneyBadger:
         )
         self._epochs: Dict[int, _EpochState] = {}
         # production: unpredictable sampling (censorship resistance);
-        # seeded: reproducible for tests (config.seed docs)
-        self._rng = (
-            random.SystemRandom()
-            if config.seed is None
-            else random.Random(f"{config.seed}|{node_id}")
-        )
+        # seeded: reproducible for tests (config.seed docs).  The
+        # seed-vs-SystemRandom fork lives in ONE audited helper
+        # (utils.determinism.proposal_rng) — plane code never touches
+        # the random module directly (staticcheck DET001).
+        self._rng = proposal_rng(config.seed, node_id)
         # recently committed txs, for lazy dedup at candidate-poll time
         # (bounded: one entry per remembered epoch)
         self._committed_filter: Set[bytes] = set()

@@ -129,7 +129,12 @@ class CryptoHub:
         # every round was a top-5 epoch cost.  A client that stages
         # work without marking itself dirty will stall: marking is
         # part of the client protocol (see class docstring).
-        self._dirty: set = set()
+        # An insertion-ordered dict-as-set, NOT a set: flush order
+        # decides the order work items batch and verdict callbacks
+        # fire, which decides outbound payload order — id()-hash set
+        # order would let two runs of the same seeded schedule ship
+        # waves in different orders (staticcheck DET002).
+        self._dirty: Dict[object, None] = {}
         self._flushing = False
         # Deferred mode (HoneyBadger.transport_manages_idle sets
         # ``hub.defer = True`` when its transport promises an idle
@@ -156,12 +161,13 @@ class CryptoHub:
         """Client protocol: call whenever pending crypto work appears
         or becomes unblocked (a parked branch, a staged decode, a
         pooled share).  Idempotent and O(1)."""
-        self._dirty.add(client)
+        self._dirty[client] = None
 
     def drop_scope(self, scope) -> None:
         dropped = self._clients.pop(scope, None)
         if dropped:
-            self._dirty.difference_update(dropped)
+            for client in dropped:
+                self._dirty.pop(client, None)
         if self.dedup:
             # epoch GC is the natural memo eviction point: all of a
             # completed epoch's keys are dead, and any live entry a

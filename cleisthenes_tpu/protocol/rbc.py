@@ -422,8 +422,11 @@ class RBC:
                         (root, sender, shard, sidx),
                     )
                 )
-        # staged decode requests with enough verified shards
-        for root in list(self._decode_req):
+        # staged decode requests with enough verified shards; sorted:
+        # _decode_req is a set of 32-byte roots, and its hash order
+        # (PYTHONHASHSEED-dependent) would otherwise decide decode
+        # batching and READY emission order across instances
+        for root in sorted(self._decode_req):
             if root in self._decoded or root in self._bad_roots:
                 self._decode_req.discard(root)
                 continue

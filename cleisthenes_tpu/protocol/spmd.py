@@ -44,6 +44,10 @@ decides the round exactly as protocol.bba does — so round counts are
 the true geometric distribution, not a stub.
 """
 
+# staticcheck: allow-file[DET001] bench executor: time.perf_counter here
+# only fills the returned stats dict (wall-clock observability); no
+# timing value ever feeds protocol state, wire bytes, or the commit rule
+
 from __future__ import annotations
 
 import collections

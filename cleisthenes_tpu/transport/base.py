@@ -28,6 +28,7 @@ from cleisthenes_tpu.transport.message import (
     attach_signature,
     signing_bytes,
 )
+from cleisthenes_tpu.utils.determinism import guarded_by
 
 
 @runtime_checkable
@@ -277,6 +278,7 @@ class HmacAuthenticator(Authenticator):
 # ---------------------------------------------------------------------------
 
 
+@guarded_by("_lock", "_conns")
 class ConnectionPool:
     """id -> Connection map with broadcast (reference conn.go:186-216),
     lock-guarded (fixing the reference's unguarded map)."""

@@ -41,6 +41,7 @@ from cleisthenes_tpu.transport.message import (
     decode_frame,
     encode_message,
 )
+from cleisthenes_tpu.utils.determinism import guarded_by
 
 SERVICE_NAME = "cleisthenes.StreamService"
 METHOD_NAME = "MessageStream"
@@ -183,7 +184,7 @@ class GrpcConnection:
                 handler = self._handler
                 if handler is not None:
                     handler.serve_request(msg)
-        except Exception:
+        except Exception:  # staticcheck: allow[ERR001] finally closes the conn
             pass  # stream broken: fall through to close
         finally:
             self.close()
@@ -193,6 +194,7 @@ ConnHandler = Callable[[GrpcConnection], None]  # comm.go:18
 ErrHandler = Callable[[Exception], None]  # comm.go:19
 
 
+@guarded_by("_lock", "_conns")
 class GrpcServer:
     """Reference comm.go:21-99 GrpcServer.
 
@@ -337,7 +339,7 @@ class GrpcClient:
             finally:
                 try:
                     ch.close()
-                except Exception:
+                except Exception:  # staticcheck: allow[ERR001] best-effort close
                     pass
                 try:
                     self._channels.remove(ch)

@@ -30,6 +30,8 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from cleisthenes_tpu.utils.determinism import guarded_by
+
 UP = "up"
 DEGRADED = "degraded"
 DOWN = "down"
@@ -115,6 +117,7 @@ class _PeerHealth:
             self.since = time.monotonic()
 
 
+@guarded_by("_lock", "_peers")
 class PeerHealthTracker:
     """Thread-safe per-peer health registry for one validator host.
 
@@ -128,7 +131,9 @@ class PeerHealthTracker:
         }
         self._lock = threading.Lock()
 
-    def _peer(self, peer_id: str) -> _PeerHealth:
+    def _peer_locked(self, peer_id: str) -> _PeerHealth:
+        """Lookup-or-create; caller holds ``_lock`` (CONC001 naming
+        contract)."""
         ph = self._peers.get(peer_id)
         if ph is None:
             ph = self._peers[peer_id] = _PeerHealth()
@@ -138,17 +143,17 @@ class PeerHealthTracker:
         """A redial was scheduled ``delay_s`` in the future: record the
         backoff curve (the anti-spinning evidence)."""
         with self._lock:
-            ph = self._peer(peer_id)
+            ph = self._peer_locked(peer_id)
             ph.recent_delays.append(delay_s)
             del ph.recent_delays[:-_DELAY_KEEP]
 
     def dial_started(self, peer_id: str) -> None:
         with self._lock:
-            self._peer(peer_id).dial_attempts += 1
+            self._peer_locked(peer_id).dial_attempts += 1
 
     def dial_failed(self, peer_id: str) -> None:
         with self._lock:
-            ph = self._peer(peer_id)
+            ph = self._peer_locked(peer_id)
             ph.dial_failures += 1
             ph.consecutive_failures += 1
             ph._enter(
@@ -159,7 +164,7 @@ class PeerHealthTracker:
 
     def connected(self, peer_id: str) -> None:
         with self._lock:
-            ph = self._peer(peer_id)
+            ph = self._peer_locked(peer_id)
             if ph.ever_up and ph.state != UP:
                 # re-establishment, not the boot-time first connect
                 ph.reconnects += 1
@@ -169,12 +174,12 @@ class PeerHealthTracker:
 
     def stream_lost(self, peer_id: str) -> None:
         with self._lock:
-            ph = self._peer(peer_id)
+            ph = self._peer_locked(peer_id)
             ph._enter(DEGRADED)
 
     def state(self, peer_id: str) -> str:
         with self._lock:
-            return self._peer(peer_id).state
+            return self._peer_locked(peer_id).state
 
     def snapshot(self) -> Dict[str, Dict[str, object]]:
         """Per-peer health block for Metrics.snapshot()."""

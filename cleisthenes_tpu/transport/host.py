@@ -43,6 +43,7 @@ from cleisthenes_tpu.transport.health import (
     backoff_rng,
 )
 from cleisthenes_tpu.transport.message import Message, Payload
+from cleisthenes_tpu.utils.determinism import guarded_by
 from cleisthenes_tpu.utils.log import NodeLogger
 
 
@@ -133,6 +134,7 @@ class SerialDispatcher:
         self._q.put(None)
 
 
+@guarded_by("_lock", "_ready", "_pending")
 class GrpcPayloadBroadcaster:
     """PayloadBroadcaster over dialed peer connections + local
     short-circuit (transport.broadcast.ChannelBroadcaster's gRPC twin).
@@ -306,7 +308,10 @@ class ValidatorHost:
                         raise
                     delay = backoff.next_delay()
                     self.health.dial_scheduled(member, delay)
-                    time.sleep(delay)
+                    # interruptible like _redial_loop's wait: stop()
+                    # must not block behind a capped-backoff sleep
+                    if self._stopping.wait(delay):
+                        raise
         self.out.mark_ready()
         self.log.info("connected", peers=len(self.pool))
         if self.node.epoch > 0:

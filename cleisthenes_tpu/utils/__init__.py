@@ -1,5 +1,6 @@
 """Cross-cutting utilities: metrics, tracing, deterministic helpers."""
 
+from cleisthenes_tpu.utils.determinism import guarded_by, proposal_rng
 from cleisthenes_tpu.utils.metrics import (
     Counter,
     EpochTrace,
@@ -7,4 +8,11 @@ from cleisthenes_tpu.utils.metrics import (
     Metrics,
 )
 
-__all__ = ["Counter", "Histogram", "EpochTrace", "Metrics"]
+__all__ = [
+    "Counter",
+    "Histogram",
+    "EpochTrace",
+    "Metrics",
+    "guarded_by",
+    "proposal_rng",
+]

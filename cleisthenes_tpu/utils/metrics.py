@@ -16,7 +16,10 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from cleisthenes_tpu.utils.determinism import guarded_by
 
+
+@guarded_by("_lock", "_v")
 class Counter:
     """Monotonic counter (thread-safe)."""
 
@@ -30,9 +33,11 @@ class Counter:
 
     @property
     def value(self) -> int:
-        return self._v
+        with self._lock:
+            return self._v
 
 
+@guarded_by("_lock", "_sorted", "_ring")
 class Histogram:
     """Sorted-reservoir histogram with exact percentiles.
 
@@ -68,7 +73,8 @@ class Histogram:
 
     @property
     def count(self) -> int:
-        return len(self._ring)
+        with self._lock:
+            return len(self._ring)
 
     @property
     def p50(self) -> Optional[float]:
@@ -111,6 +117,7 @@ class EpochTrace:
         return self.t_commit - self.t_acs_output
 
 
+@guarded_by("_lock", "_traces")
 class Metrics:
     """Per-node metrics registry."""
 

@@ -1,0 +1,31 @@
+"""Known-bad CONC001 corpus: guarded attributes touched outside the
+declared lock."""
+
+import threading
+
+from cleisthenes_tpu.utils.determinism import guarded_by
+
+
+@guarded_by("_lock", "_items", "_count")
+class Store:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = {}
+        self._count = 0
+
+    def ok_add(self, k, v):
+        with self._lock:
+            self._items[k] = v
+            self._count += 1
+
+    def bad_get(self, k):
+        return self._items.get(k)  # BAD:CONC001
+
+    def bad_after_release(self):
+        with self._lock:
+            n = self._count
+        return n + self._count  # BAD:CONC001
+
+    def _scan_locked(self):
+        # *_locked naming contract: caller holds the lock — exempt
+        return len(self._items)

@@ -1,0 +1,32 @@
+"""Known-good CONC001 corpus: disciplined access, *_locked helpers,
+and an unannotated class (out of the rule's scope by construction)."""
+
+import threading
+
+from cleisthenes_tpu.utils.determinism import guarded_by
+
+
+@guarded_by("_lock", "_items")
+class Store:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = {}
+
+    def add(self, k, v):
+        with self._lock:
+            self._items[k] = v
+
+    def snapshot(self):
+        with self._lock:
+            return dict(self._items)
+
+    def _size_locked(self):
+        return len(self._items)
+
+
+class Unannotated:
+    def __init__(self):
+        self._items = {}
+
+    def touch(self):
+        return len(self._items)

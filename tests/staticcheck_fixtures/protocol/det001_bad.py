@@ -1,0 +1,34 @@
+"""Known-bad DET001 corpus: every banned construct, one per marked
+line.  Tagged lines (BAD markers) must each yield exactly one finding
+(tests/test_staticcheck.py asserts the exact set).  The ``protocol/``
+directory name puts this file in the determinism plane for the
+analyzer — same path-derived scoping as the real package."""
+
+import os
+import random
+import secrets
+import time
+import uuid
+import secrets as _sec
+
+
+def clocks():
+    a = time.time()  # BAD:DET001
+    b = time.monotonic()  # BAD:DET001
+    c = time.perf_counter()  # BAD:DET001
+    return a, b, c
+
+
+def entropy():
+    w = secrets.token_bytes(8)  # BAD:DET001
+    x = os.urandom(8)  # BAD:DET001
+    y = uuid.uuid4()  # BAD:DET001
+    z = random.random()  # BAD:DET001
+    r = random.SystemRandom()  # BAD:DET001
+    s = _sec.token_bytes(4)  # BAD:DET001
+    t = random.Random()  # BAD:DET001
+    return w, x, y, z, r, s, t
+
+
+def seeded_is_fine(seed):
+    return random.Random(seed)

@@ -1,0 +1,19 @@
+"""Known-good DET001 corpus: the sanctioned shapes — seeded RNGs,
+hash-derived streams, and the audited utils helper."""
+
+import hashlib
+import random
+
+from cleisthenes_tpu.utils.determinism import proposal_rng
+
+
+def seeded_rng(seed: int, node_id: str) -> random.Random:
+    return random.Random(f"{seed}|{node_id}")
+
+
+def hash_stream(seed: int, ctr: int) -> bytes:
+    return hashlib.sha256(b"dealer|%d|%d" % (seed, ctr)).digest()
+
+
+def audited(seed, node_id):
+    return proposal_rng(seed, node_id)

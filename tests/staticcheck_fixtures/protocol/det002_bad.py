@@ -1,0 +1,23 @@
+"""Known-bad DET002 corpus: set iteration reaching order-sensitive
+sinks without sorted()."""
+
+
+class Proto:
+    def __init__(self):
+        self.roots = set()
+        self.names: set = set()
+
+    def walk(self):
+        for r in self.roots:  # BAD:DET002
+            del r
+        return [x for x in self.names]  # BAD:DET002
+
+
+def local_sets():
+    s = {b"a", b"b"}
+    out = list(s)  # BAD:DET002
+    t = frozenset((1, 2))
+    m = max(t)  # BAD:DET002
+    for x in set((1, 2)):  # BAD:DET002
+        del x
+    return out, m

@@ -1,0 +1,24 @@
+"""Known-good DET002 corpus: sorted() boundaries, membership tests,
+len(), set algebra, and (insertion-ordered) dict iteration."""
+
+
+class Proto:
+    def __init__(self):
+        self.roots = set()
+        self.tally = {}
+
+    def walk(self):
+        for r in sorted(self.roots):
+            del r
+        out = list(sorted(self.roots))
+        if b"x" in self.roots:
+            out.append(b"x")
+        for k, v in self.tally.items():  # dicts are insertion-ordered
+            del k, v
+        return out, len(self.roots)
+
+
+def set_algebra(a, b):
+    merged = set(a) | set(b)
+    merged -= set(b)
+    return sorted(merged)

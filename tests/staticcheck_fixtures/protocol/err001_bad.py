@@ -1,0 +1,25 @@
+"""Known-bad ERR001 corpus: bare excepts and silent swallows."""
+
+
+def handle_vote(x):
+    try:
+        return int(x)
+    except:  # BAD:ERR001
+        return None
+
+
+def handle_share(x):
+    try:
+        return float(x)
+    except Exception:  # BAD:ERR001
+        pass
+
+
+def handle_rows(rows):
+    out = []
+    for r in rows:
+        try:
+            out.append(int(r))
+        except BaseException:  # BAD:ERR001
+            continue
+    return out

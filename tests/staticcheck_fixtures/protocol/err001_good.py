@@ -1,0 +1,26 @@
+"""Known-good ERR001 corpus: narrow excepts, and blanket excepts that
+actually handle (deterministic-exclusion idiom, re-raise, logging)."""
+
+
+def handle_vote(x):
+    try:
+        return int(x)
+    except ValueError:
+        return None
+
+
+def handle_junk(decode, blob, excluded):
+    try:
+        return decode(blob)
+    except Exception:
+        # every correct node sees the same bytes: exclusion is the
+        # deterministic handling, not a swallow
+        excluded.add(blob)
+        return None
+
+
+def handle_fatal(op):
+    try:
+        return op()
+    except Exception:
+        raise

@@ -1,0 +1,12 @@
+"""Pragma corpus: a justified allow suppresses; a bare allow is
+itself a finding (PRAGMA001) and suppresses nothing."""
+
+import time
+
+
+def sanctioned():
+    return time.monotonic()  # staticcheck: allow[DET001] fixture: justified waiver
+
+
+def unsanctioned():
+    return time.time()  # staticcheck: allow[DET001]

@@ -1,0 +1,13 @@
+"""File-pragma corpus: allow-file waives a rule for the whole file."""
+
+# staticcheck: allow-file[DET001] fixture: stats-only module, whole-file waiver
+
+import time
+
+
+def t1():
+    return time.time()
+
+
+def t2():
+    return time.monotonic()

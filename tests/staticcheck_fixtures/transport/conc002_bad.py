@@ -1,0 +1,19 @@
+"""Known-bad CONC002 corpus: blocking calls inside handler callbacks
+(the ``transport/`` directory name puts this in the rule's scope)."""
+
+import time
+
+
+class Conn:
+    def serve_request(self, msg):
+        time.sleep(0.1)  # BAD:CONC002
+        return msg
+
+    def handle_frame(self, sock):
+        return sock.recv(1024)  # BAD:CONC002
+
+    def on_message(self, sock):
+        sock.sendall(b"ack")  # BAD:CONC002
+
+    def _handle_accept(self, listener):
+        return listener.accept()  # BAD:CONC002

@@ -1,0 +1,21 @@
+"""Known-good CONC002 corpus: handlers that enqueue instead of block,
+and blocking calls confined to non-handler worker loops."""
+
+import time
+
+
+class Conn:
+    def __init__(self):
+        self.outbox = []
+
+    def serve_request(self, msg):
+        self.outbox.append(msg)  # enqueue; the writer thread ships it
+
+    def handle_frame(self, frame):
+        return len(frame)
+
+    def writer_loop(self, sock):
+        # not a handler: the dedicated writer thread may block
+        while self.outbox:
+            sock.sendall(self.outbox.pop(0))
+            time.sleep(0.01)

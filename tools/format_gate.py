@@ -7,6 +7,9 @@ Checked per file: parses as Python (ast), LF line endings, trailing
 newline at EOF, no tabs in code, no trailing whitespace, lines <= 99
 columns.  Exit 1 with a file:line listing on any violation.
 
+File walking and reporting are shared with tools/staticcheck via
+tools/lintcommon, so the two gates always scan the same tree.
+
 Usage:  python tools/format_gate.py
 """
 
@@ -16,22 +19,21 @@ import ast
 import pathlib
 import sys
 
-MAX_COLS = 99
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
-ROOT = pathlib.Path(__file__).parent.parent
-TARGETS = (
-    sorted(ROOT.joinpath("cleisthenes_tpu").rglob("*.py"))
-    + sorted(ROOT.joinpath("tests").rglob("*.py"))
-    + sorted(ROOT.joinpath("tools").glob("*.py"))
-    + [ROOT / "bench.py", ROOT / "__graft_entry__.py", ROOT / "demo.py"]
+from tools.lintcommon import (  # noqa: E402
+    REPO_ROOT,
+    gate_targets,
+    rel_posix,
+    report,
 )
 
+MAX_COLS = 99
 
-def check(path: pathlib.Path) -> list[str]:
-    if not path.exists():
-        return []
+
+def check(path: pathlib.Path) -> list:
     raw = path.read_bytes()
-    rel = path.relative_to(ROOT)
+    rel = rel_posix(path)
     problems = []
     if b"\r" in raw:
         problems.append(f"{rel}: CR line endings")
@@ -43,7 +45,7 @@ def check(path: pathlib.Path) -> list[str]:
         problems.append(f"{rel}: not valid UTF-8 at byte {e.start}")
         return problems
     try:
-        ast.parse(text, filename=str(rel))
+        ast.parse(text, filename=rel)
     except SyntaxError as e:
         problems.append(f"{rel}:{e.lineno}: syntax error: {e.msg}")
         return problems
@@ -58,16 +60,11 @@ def check(path: pathlib.Path) -> list[str]:
 
 
 def main() -> int:
-    problems: list[str] = []
-    for path in TARGETS:
+    targets = gate_targets(REPO_ROOT)
+    problems: list = []
+    for path in targets:
         problems.extend(check(path))
-    for p in problems:
-        print(p)
-    print(
-        f"format gate: {len(TARGETS)} files, "
-        f"{len(problems)} problem(s)"
-    )
-    return 1 if problems else 0
+    return report("format gate", len(targets), problems)
 
 
 if __name__ == "__main__":

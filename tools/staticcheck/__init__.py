@@ -1,0 +1,41 @@
+"""staticcheck: stdlib-only AST static analysis for the determinism
+plane (tools/staticcheck).
+
+The value proposition of this stack is that seeded runs replay exactly
+— ci.sh's race-analog tier depends on it, and CATCHUP/WAL recovery
+depends on committed bytes being identical across nodes.  Nothing
+enforced that invariant until this package: it is the lint-shaped gate
+that keeps wall clocks, unseeded randomness, hash-order iteration,
+lock-discipline violations and swallowed exceptions out of the code
+paths where they can fork a ledger.
+
+Layout:
+  core.py   -- Finding/FileContext, pragma parsing, rule registry,
+               baseline round-trip, the runner
+  rules.py  -- the rule catalog (DET001/DET002/CONC001/CONC002/ERR001)
+  __main__  -- CLI: ``python -m tools.staticcheck cleisthenes_tpu``
+
+See docs/ARCHITECTURE.md "Determinism plane & static analysis" for
+the plane definition, the rule catalog, and the pragma policy.
+"""
+
+from tools.staticcheck.core import (
+    BASELINE_PATH,
+    Finding,
+    check_paths,
+    load_baseline,
+    registered_rules,
+    split_baselined,
+    write_baseline,
+)
+import tools.staticcheck.rules  # noqa: F401  (registers the catalog)
+
+__all__ = [
+    "BASELINE_PATH",
+    "Finding",
+    "check_paths",
+    "load_baseline",
+    "registered_rules",
+    "split_baselined",
+    "write_baseline",
+]

@@ -1,0 +1,112 @@
+"""CLI: the ci.sh staticcheck gate stage.
+
+Usage:
+    python -m tools.staticcheck cleisthenes_tpu            # gate mode
+    python -m tools.staticcheck cleisthenes_tpu --json     # full JSON
+    python -m tools.staticcheck pkg --write-baseline       # grandfather
+    python -m tools.staticcheck pkg --no-baseline          # raw view
+
+Exit 0 iff no unbaselined findings.  Gate mode prints one line per
+fresh finding plus a one-line JSON summary (machine-greppable in CI
+logs) and the human summary via the shared reporter.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[2]))
+
+from tools.lintcommon import REPO_ROOT, report  # noqa: E402
+from tools.staticcheck import (  # noqa: E402
+    BASELINE_PATH,
+    check_paths,
+    load_baseline,
+    registered_rules,
+    split_baselined,
+    write_baseline,
+)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="tools.staticcheck")
+    ap.add_argument(
+        "paths",
+        nargs="*",
+        default=["cleisthenes_tpu"],
+        help="files/dirs to scan (repo-relative; default: the package)",
+    )
+    ap.add_argument(
+        "--json", action="store_true", help="emit full findings as JSON"
+    )
+    ap.add_argument(
+        "--baseline",
+        type=pathlib.Path,
+        default=BASELINE_PATH,
+        help="baseline file (default: tools/staticcheck/baseline.json)",
+    )
+    ap.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="ignore the baseline (show every finding)",
+    )
+    ap.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="grandfather the current findings and exit 0",
+    )
+    ap.add_argument(
+        "--rules",
+        help="comma-separated rule ids to run (default: all)",
+    )
+    args = ap.parse_args(argv)
+
+    targets = [
+        p if p.is_absolute() else REPO_ROOT / p
+        for p in (pathlib.Path(s) for s in args.paths)
+    ]
+    rule_ids = args.rules.split(",") if args.rules else None
+    findings, n_files = check_paths(targets, REPO_ROOT, rule_ids)
+
+    if args.write_baseline:
+        write_baseline(findings, args.baseline)
+        print(
+            f"staticcheck: baselined {len(findings)} finding(s) "
+            f"-> {args.baseline}"
+        )
+        return 0
+
+    baseline = {} if args.no_baseline else load_baseline(args.baseline)
+    fresh, old = split_baselined(findings, baseline)
+
+    summary = {
+        "files": n_files,
+        "findings": len(fresh),
+        "baselined": len(old),
+        "rules": sorted(registered_rules()),
+    }
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "summary": summary,
+                    "findings": [f.to_json() for f in fresh],
+                    "baselined": [f.to_json() for f in old],
+                },
+                indent=2,
+            )
+        )
+        return 1 if fresh else 0
+    return report(
+        "staticcheck",
+        n_files,
+        [f.render() for f in fresh],
+        extra=[json.dumps(summary, sort_keys=True)],
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

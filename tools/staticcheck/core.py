@@ -1,0 +1,333 @@
+"""staticcheck engine: findings, pragmas, baseline, rule registry.
+
+Design (mirrors go vet / staticcheck-style gates, stdlib-only):
+
+- A *rule* is a class with an ``id``, a ``doc`` line and a
+  ``check(ctx)`` generator yielding Findings; it registers itself via
+  the ``@rule`` decorator (tools/staticcheck/rules.py holds the
+  catalog).
+- *Pragmas* suppress findings at the source: a trailing
+  ``# staticcheck: allow[RULE] justification`` suppresses that RULE on
+  that line; ``# staticcheck: allow-file[RULE] justification`` (its
+  own line) suppresses the rule for the whole file.  A pragma WITHOUT
+  a justification is itself a finding (PRAGMA001) and suppresses
+  nothing — every sanctioned exception must say why.
+- The *baseline* (tools/staticcheck/baseline.json) grandfathers known
+  findings so the gate can land before the tree is fully clean.  Keys
+  are (rule, path, source-line-text) — stable across unrelated line
+  drift.  The merged tree's baseline is EMPTY: every finding is fixed
+  or pragma'd.
+- Scoping is path-derived: FileContext computes ``in_plane`` (any of
+  protocol/, core/, ops/ in the path — the determinism plane) and
+  ``in_transport``; each rule reads the flags it cares about.  The
+  fixture corpus under tests/staticcheck_fixtures/ reuses exactly this
+  mechanism by nesting fixtures in protocol/ / transport/ dirs.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import pathlib
+import re
+from typing import Dict, Iterable, Iterator, List, Optional, Tuple
+
+from tools.lintcommon import REPO_ROOT, rel_posix, walk_python_files
+
+BASELINE_PATH = pathlib.Path(__file__).resolve().parent / "baseline.json"
+
+# directories (path segments) that define the analysis scopes
+PLANE_DIRS = frozenset(("protocol", "core", "ops"))
+TRANSPORT_DIRS = frozenset(("transport",))
+
+_PRAGMA_RE = re.compile(
+    r"#\s*staticcheck:\s*(allow|allow-file)\[([A-Za-z0-9_,\s]+)\]\s*(.*)$"
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at a source location."""
+
+    rule: str
+    path: str  # repo-relative posix path
+    line: int  # 1-based
+    col: int  # 0-based (ast convention)
+    message: str
+    snippet: str = ""  # stripped source line: the baseline key part
+
+    @property
+    def key(self) -> str:
+        return f"{self.rule}|{self.path}|{self.snippet}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+    def to_json(self) -> Dict[str, object]:
+        return dataclasses.asdict(self)
+
+
+class FileContext:
+    """Everything a rule needs about one file: source, AST, scope
+    flags, and import-alias resolution."""
+
+    def __init__(
+        self, path: pathlib.Path, root: pathlib.Path = REPO_ROOT
+    ) -> None:
+        self.path = path
+        self.relpath = rel_posix(path, root)
+        self.text = path.read_text(encoding="utf-8")
+        self.lines = self.text.splitlines()
+        self.tree = ast.parse(self.text, filename=self.relpath)
+        parts = frozenset(pathlib.PurePosixPath(self.relpath).parts)
+        self.in_plane = bool(parts & PLANE_DIRS)
+        self.in_transport = bool(parts & TRANSPORT_DIRS)
+        self._aliases = _import_aliases(self.tree)
+
+    def resolve(self, node: ast.AST) -> Optional[str]:
+        """Dotted name a Name/Attribute refers to, through import
+        aliases: ``_secrets.token_bytes`` -> ``secrets.token_bytes``,
+        ``monotonic`` (from time import monotonic) ->
+        ``time.monotonic``.  None for anything unresolvable."""
+        if isinstance(node, ast.Name):
+            return self._aliases.get(node.id)
+        if isinstance(node, ast.Attribute):
+            base = self.resolve(node.value)
+            if base is None and isinstance(node.value, ast.Name):
+                base = self._aliases.get(node.value.id)
+            if base is not None:
+                return f"{base}.{node.attr}"
+        return None
+
+    def source_line(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def finding(
+        self, rule_id: str, node: ast.AST, message: str
+    ) -> Finding:
+        line = getattr(node, "lineno", 1)
+        return Finding(
+            rule=rule_id,
+            path=self.relpath,
+            line=line,
+            col=getattr(node, "col_offset", 0),
+            message=message,
+            snippet=self.source_line(line),
+        )
+
+
+def _import_aliases(tree: ast.AST) -> Dict[str, str]:
+    """local name -> dotted origin, for imports anywhere in the file
+    (function-local imports are the codebase's lazy-import idiom)."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0]
+                )
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                out[a.asname or a.name] = f"{node.module}.{a.name}"
+    return out
+
+
+# ---------------------------------------------------------------------------
+# rule registry
+# ---------------------------------------------------------------------------
+
+_RULES: Dict[str, object] = {}
+
+
+def rule(cls):
+    """Class decorator: instantiate + register a rule by its ``id``."""
+    inst = cls()
+    if inst.id in _RULES:
+        raise ValueError(f"duplicate rule id {inst.id!r}")
+    _RULES[inst.id] = inst
+    return cls
+
+
+def registered_rules() -> Dict[str, object]:
+    return dict(_RULES)
+
+
+# ---------------------------------------------------------------------------
+# pragmas
+# ---------------------------------------------------------------------------
+
+
+class Pragmas:
+    """Per-file suppression state parsed from source comments."""
+
+    def __init__(
+        self,
+        line_allows: Dict[int, frozenset],
+        file_allows: frozenset,
+        bad: List[Finding],
+    ) -> None:
+        self.line_allows = line_allows
+        self.file_allows = file_allows
+        self.bad = bad  # PRAGMA001 findings (missing justification)
+
+    def suppresses(self, f: Finding) -> bool:
+        if f.rule in self.file_allows:
+            return True
+        return f.rule in self.line_allows.get(f.line, frozenset())
+
+
+def parse_pragmas(ctx: FileContext) -> Pragmas:
+    line_allows: Dict[int, frozenset] = {}
+    file_allows: set = set()
+    bad: List[Finding] = []
+    for i, line in enumerate(ctx.lines, 1):
+        m = _PRAGMA_RE.search(line)
+        if m is None:
+            continue
+        kind, rules_s, justification = m.groups()
+        rules = frozenset(
+            r.strip() for r in rules_s.split(",") if r.strip()
+        )
+        if not justification.strip():
+            bad.append(
+                Finding(
+                    rule="PRAGMA001",
+                    path=ctx.relpath,
+                    line=i,
+                    col=line.index("#"),
+                    message=(
+                        f"pragma allow[{rules_s}] has no justification; "
+                        "it suppresses nothing"
+                    ),
+                    snippet=line.strip(),
+                )
+            )
+            continue
+        if kind == "allow-file":
+            file_allows |= rules
+        else:
+            line_allows[i] = line_allows.get(i, frozenset()) | rules
+    return Pragmas(line_allows, frozenset(file_allows), bad)
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+
+
+def load_baseline(path: pathlib.Path = BASELINE_PATH) -> Dict[str, int]:
+    """key -> grandfathered count; empty when absent."""
+    if not path.exists():
+        return {}
+    data = json.loads(path.read_text(encoding="utf-8"))
+    return {str(k): int(v) for k, v in data.get("findings", {}).items()}
+
+
+def write_baseline(
+    findings: Iterable[Finding], path: pathlib.Path = BASELINE_PATH
+) -> None:
+    counts: Dict[str, int] = {}
+    for f in findings:
+        counts[f.key] = counts.get(f.key, 0) + 1
+    path.write_text(
+        json.dumps(
+            {"version": 1, "findings": dict(sorted(counts.items()))},
+            indent=2,
+        )
+        + "\n",
+        encoding="utf-8",
+    )
+
+
+def split_baselined(
+    findings: List[Finding], baseline: Dict[str, int]
+) -> Tuple[List[Finding], List[Finding]]:
+    """(fresh, grandfathered): each baseline entry absorbs at most its
+    recorded count, so NEW copies of an old finding still gate."""
+    budget = dict(baseline)
+    fresh: List[Finding] = []
+    old: List[Finding] = []
+    for f in findings:
+        if budget.get(f.key, 0) > 0:
+            budget[f.key] -= 1
+            old.append(f)
+        else:
+            fresh.append(f)
+    return fresh, old
+
+
+# ---------------------------------------------------------------------------
+# runner
+# ---------------------------------------------------------------------------
+
+
+def check_file(
+    path: pathlib.Path,
+    root: pathlib.Path = REPO_ROOT,
+    rule_ids: Optional[Iterable[str]] = None,
+) -> List[Finding]:
+    """All (pragma-filtered) findings for one file, line-ordered."""
+    try:
+        ctx = FileContext(path, root)
+    except SyntaxError as e:
+        # the format gate owns syntax; surface it here too so a
+        # standalone staticcheck run never crashes on a broken file
+        return [
+            Finding(
+                rule="PARSE",
+                path=rel_posix(path, root),
+                line=e.lineno or 1,
+                col=e.offset or 0,
+                message=f"does not parse: {e.msg}",
+            )
+        ]
+    pragmas = parse_pragmas(ctx)
+    wanted = set(rule_ids) if rule_ids is not None else None
+    out: List[Finding] = list(pragmas.bad)
+    for rid, r in _RULES.items():
+        if wanted is not None and rid not in wanted:
+            continue
+        for f in r.check(ctx):
+            if not pragmas.suppresses(f):
+                out.append(f)
+    out.sort(key=lambda f: (f.line, f.col, f.rule))
+    return out
+
+
+def check_paths(
+    paths: Iterable[pathlib.Path],
+    root: pathlib.Path = REPO_ROOT,
+    rule_ids: Optional[Iterable[str]] = None,
+) -> Tuple[List[Finding], int]:
+    """(findings, files_scanned) across every .py under ``paths``."""
+    findings: List[Finding] = []
+    n_files = 0
+    for target in paths:
+        for py in walk_python_files(target):
+            n_files += 1
+            findings.extend(check_file(py, root, rule_ids))
+    return findings, n_files
+
+
+def _finding_iter(findings: List[Finding]) -> Iterator[str]:
+    for f in findings:
+        yield f.render()
+
+
+__all__ = [
+    "BASELINE_PATH",
+    "FileContext",
+    "Finding",
+    "Pragmas",
+    "check_file",
+    "check_paths",
+    "load_baseline",
+    "parse_pragmas",
+    "registered_rules",
+    "rule",
+    "split_baselined",
+    "write_baseline",
+]
