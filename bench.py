@@ -216,24 +216,41 @@ def modexp_comparator_note() -> str:
 # ---------------------------------------------------------------------------
 
 
-def build_network(backend: str, n: int = 16, batch: int = 1024):
+def build_network(
+    backend: str, n: int = 16, batch: int = 1024, trace: bool = False
+):
     """An in-proc cluster with the shared (cluster-batched) hub — see
     protocol.cluster.SimulatedCluster; manual epoch stepping."""
     from cleisthenes_tpu.config import Config
     from cleisthenes_tpu.protocol.cluster import SimulatedCluster
 
-    cfg = Config(n=n, batch_size=batch, crypto_backend=backend, seed=99)
+    cfg = Config(
+        n=n, batch_size=batch, crypto_backend=backend, seed=99, trace=trace
+    )
     cluster = SimulatedCluster(
         config=cfg, key_seed=77, auto_propose=False, shared_hub=True
     )
-    return cfg, cluster.net, cluster.nodes
+    return cfg, cluster.net, cluster.nodes, cluster
 
 
-def measure_protocol(backend: str, n: int, batch: int, epochs: int) -> dict:
+def measure_protocol(
+    backend: str,
+    n: int,
+    batch: int,
+    epochs: int,
+    trace: bool = False,
+    trace_out: "str | None" = None,
+) -> dict:
     """``epochs`` measured full epochs (plus one untimed warm-up epoch
     with its OWN transactions, so warm-up never eats measured work —
-    VERDICT round-2 item 8)."""
-    cfg, net, nodes = build_network(backend, n=n, batch=batch)
+    VERDICT round-2 item 8).  With ``trace=True`` the cluster runs
+    under the flight recorder and the result carries a per-stage
+    breakdown of epoch wall time (``stage_shares``) next to
+    ``epoch_p50_ms`` — the instrument that makes a BENCH_* number
+    explain itself (ISSUE 3)."""
+    cfg, net, nodes, cluster = build_network(
+        backend, n=n, batch=batch, trace=trace
+    )
     rng = np.random.default_rng(13)
     node_ids = sorted(nodes)
     total_txs = batch * (epochs + 1)  # +1: the warm-up epoch's own txs
@@ -268,7 +285,7 @@ def measure_protocol(backend: str, n: int, batch: int, epochs: int) -> dict:
     assert len(histories) == 1, "protocol benchmark broke agreement"
     p50 = statistics.median(epoch_times) if epoch_times else None
     total_t = sum(epoch_times)
-    return {
+    out = {
         "epoch_p50_ms": round(p50 * 1000.0, 3) if p50 is not None else None,
         # raw per-epoch walls: relay drift (8 s -> 28 s inside one
         # session was observed in round 3) must be visible in the
@@ -282,6 +299,17 @@ def measure_protocol(backend: str, n: int, batch: int, epochs: int) -> dict:
             nodes[node_ids[0]].hub.stats()["dispatches"]
         ),
     }
+    if trace:
+        from cleisthenes_tpu.utils.trace import to_chrome
+        from tools import tracetool
+
+        doc = to_chrome(cluster.trace_events())
+        if trace_out:
+            with open(trace_out, "w") as f:
+                json.dump(doc, f)
+        out["stage_shares"] = tracetool.stage_shares(doc)
+        out["trace_stats"] = nodes[node_ids[0]].metrics.snapshot()["trace"]
+    return out
 
 
 def measure_spmd(
@@ -1000,8 +1028,61 @@ def _run_locked() -> None:
     )
 
 
+def run_trace() -> None:
+    """bench.py --trace [--trace-out PATH]: the protocol_n16 scenario
+    under the flight recorder (utils/trace.py) — one JSON line whose
+    ``stage_shares`` sits next to ``epoch_p50_ms`` and says where the
+    epoch's wall time went (rbc/bba/coin/tpke/hub/transport/...), so
+    BENCH_* numbers finally explain themselves.  Runs on the CPU
+    reference backend: the breakdown is about epoch anatomy, not the
+    chip.  ``--trace-out`` additionally writes the Perfetto-loadable
+    artifact (docs/TRACING.md)."""
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="bench.py --trace")
+    ap.add_argument("--trace", action="store_true")
+    ap.add_argument("--trace-out", metavar="PATH", default=None)
+    args, _unknown = ap.parse_known_args()
+    pc = PROTO_CONFIGS["protocol_n16"]
+    try:
+        # same exclusive-measurement contract as main(): a traced
+        # epoch number sharing the core with another capture is
+        # contaminated in BOTH directions
+        with benchlock.hold("bench.py --trace"):
+            result = measure_protocol(
+                cpu_reference_backend(),
+                pc["n"],
+                pc["batch"],
+                pc["epochs"],
+                trace=True,
+                trace_out=args.trace_out,
+            )
+    except TimeoutError as exc:
+        print(
+            json.dumps(
+                {
+                    "metric": "trace_protocol_n16",
+                    "error": f"bench lock unavailable: {exc}",
+                }
+            )
+        )
+        return
+    print(
+        json.dumps(
+            {
+                "metric": "trace_protocol_n16",
+                "n": pc["n"],
+                "batch": pc["batch"],
+                **result,
+            }
+        )
+    )
+
+
 if __name__ == "__main__":
     if "--child" in sys.argv:
         run_child()
+    elif "--trace" in sys.argv:
+        run_trace()
     else:
         main()

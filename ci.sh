@@ -16,19 +16,24 @@
 #      Fails on ANY unbaselined finding; the committed baseline is
 #      empty — every sanctioned exception is a justified pragma.
 #      Sub-second and stdlib-only, so CI_FAST runs it too.
-#   3. fast test tier      — pytest minus the multi-minute scale
+#   3. observability gate  — a seeded 4-node traced cluster captures
+#      a flight-recorder artifact (utils/trace.py) and
+#      tools/tracetool.py --validate gates its schema + per-node
+#      monotone sequence numbers, so the tracing plane cannot rot
+#      silently between perf rounds (docs/TRACING.md)
+#   4. fast test tier      — pytest minus the multi-minute scale
 #      tests, under tools/covgate.py (PEP 669 line coverage; the
 #      tier must execute >= 85% of the package's executable lines —
 #      the travis pipeline's coverage upload, translated to a GATE)
-#   4. race-analog tier    — the seeded deterministic-scheduler suites
+#   5. race-analog tier    — the seeded deterministic-scheduler suites
 #      (transport/byzantine), this stack's answer to `-race`
 #      (SURVEY.md §5.2: replayable interleavings instead of a dynamic
 #      race detector), plus the real-thread gRPC suite
-#   5. fault tier          — the crash/partition/adversary suite
+#   6. fault tier          — the crash/partition/adversary suite
 #      (`-m faults`: Byzantine coalitions, crash+WAL-restart+CATCHUP,
 #      gRPC backoff redial) replayed over a fixed 3-seed matrix, so a
 #      fault-handling regression on ANY matrix seed gates the merge
-#   6. full tier           — everything, including the N=64 slow test
+#   7. full tier           — everything, including the N=64 slow test
 #      (skipped when CI_FAST=1)
 #
 # Usage:  ./ci.sh          # full gate
@@ -37,24 +42,31 @@
 set -euo pipefail
 cd "$(dirname "$0")"
 
-echo "== [1/6] syntax + format gate"
+echo "== [1/7] syntax + format gate"
 python -m compileall -q cleisthenes_tpu tests bench.py __graft_entry__.py
 python tools/format_gate.py
 
-echo "== [2/6] staticcheck gate: determinism plane + lock discipline"
+echo "== [2/7] staticcheck gate: determinism plane + lock discipline"
 python -m tools.staticcheck cleisthenes_tpu
 
-echo "== [3/6] fast tests (with coverage gate)"
+echo "== [3/7] observability gate: traced seeded cluster -> tracetool --validate"
+TRACE_ARTIFACT="$(mktemp /tmp/cleisthenes_trace_ci.XXXXXX.json)"
+trap 'rm -f "$TRACE_ARTIFACT"' EXIT
+JAX_PLATFORMS=cpu python -m tools.tracetool \
+    --capture "$TRACE_ARTIFACT" --n 4 --seed 7 --txs 24
+python -m tools.tracetool "$TRACE_ARTIFACT" --validate
+
+echo "== [4/7] fast tests (with coverage gate)"
 COVGATE_MIN="${COVGATE_MIN:-85}" \
     python -m pytest tests/ -q -m "not slow" -x -p tools.covgate
 
-echo "== [4/6] race-analog: seeded-scheduler + threaded-transport suites"
+echo "== [5/7] race-analog: seeded-scheduler + threaded-transport suites"
 python -m pytest tests/test_transport.py tests/test_byzantine.py \
     tests/test_grpc.py -q -x
 
-echo "== [5/6] fault gate: crash/partition/adversary suite, 3-seed matrix"
+echo "== [6/7] fault gate: crash/partition/adversary suite, 3-seed matrix"
 # the full faults-marked suite already ran at the default seed in
-# stages 3-4; the matrix replays the FAULT_SEED-parametrized
+# stages 4-5; the matrix replays the FAULT_SEED-parametrized
 # crash+WAL-restart+CATCHUP scenario (the seed-sensitive entry point)
 # at every matrix seed, so a fault regression on ANY seed gates
 for seed in 11 23 47; do
@@ -64,9 +76,9 @@ for seed in 11 23 47; do
 done
 
 if [[ "${CI_FAST:-0}" == "1" ]]; then
-    echo "== [6/6] skipped (CI_FAST=1)"
+    echo "== [7/7] skipped (CI_FAST=1)"
 else
-    echo "== [6/6] full suite incl. scale tests"
+    echo "== [7/7] full suite incl. scale tests"
     python -m pytest tests/ -q -m slow
 fi
 

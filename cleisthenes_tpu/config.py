@@ -67,6 +67,14 @@ class Config:
         axis, shard-length axis) — for sharding the crypto plane
         across TPU devices via parallel.mesh.CryptoMesh; None means
         single-device.  Only consumed by the 'tpu' backend.
+      trace: enable the per-node flight recorder (utils/trace.py):
+        quorum crossings, hub flushes, wave boundaries and WAL
+        appends record into a bounded ring, mergeable into one
+        Perfetto-loadable artifact by tools/tracetool.py.  False (the
+        default) constructs NO recorder at all — instrumentation
+        sites hold None and the hot path pays one identity check.
+      trace_buffer: per-node trace ring capacity (newest events win;
+        overflow counts as drops in Metrics.snapshot()["trace"]).
     """
 
     n: int = 4
@@ -82,6 +90,8 @@ class Config:
     seed: Optional[int] = None
     coin_seed: int = 1
     mesh_shape: Optional[tuple] = None
+    trace: bool = False
+    trace_buffer: int = 1 << 16
     # Epoch pipelining (BASELINE config 5): propose into epoch e+1 the
     # moment epoch e's ACS outputs, so e+1's RS-encode/Merkle-forest
     # and VAL/ECHO exchange overlap e's decryption-share phase.
@@ -114,6 +124,10 @@ class Config:
             )
         if self.crypto_backend not in ("cpu", "cpp", "tpu"):
             raise ValueError(f"unknown crypto_backend {self.crypto_backend!r}")
+        if self.trace_buffer <= 0:
+            raise ValueError(
+                f"trace_buffer={self.trace_buffer} must be > 0"
+            )
         if self.mesh_shape is not None:
             from cleisthenes_tpu.parallel.mesh import validate_mesh_shape
 

@@ -166,6 +166,11 @@ class BatchLog:
         self._lock = threading.Lock()
         self._last_epoch: Optional[int] = None
         self._last_checkpoint: Optional[Tuple[int, List[Set[bytes]]]] = None
+        # flight recorder (utils/trace.py), set by the owning node
+        # when Config.trace is on: every append/checkpoint records a
+        # "ledger" span (write+flush+fsync cost is a real commit-path
+        # stage).  None = tracing off.
+        self.trace = None
         self._recover_locked()
         self._fh = open(path, "ab")
 
@@ -225,9 +230,15 @@ class BatchLog:
 
     def append(self, epoch: int, batch: Batch) -> None:
         rec = _encode_record(epoch, batch)
+        tr = self.trace
+        t0 = 0.0 if tr is None else tr.now()
         with self._lock:
             self._append_record_locked(rec)
             self._last_epoch = epoch
+        if tr is not None:
+            tr.complete(
+                "ledger", "wal_append", t0, epoch=epoch, bytes=len(rec)
+            )
 
     def append_checkpoint(
         self, epoch: int, history: Sequence[Set[bytes]]
@@ -238,9 +249,15 @@ class BatchLog:
         rec = _frame_record(
             _MAGIC_CKPT, _encode_checkpoint_body(epoch, history)
         )
+        tr = self.trace
+        t0 = 0.0 if tr is None else tr.now()
         with self._lock:
             self._append_record_locked(rec)
             self._last_checkpoint = (epoch, [set(s) for s in history])
+        if tr is not None:
+            tr.complete(
+                "ledger", "wal_checkpoint", t0, epoch=epoch, bytes=len(rec)
+            )
 
     def replay(self) -> Iterator[Tuple[int, Batch]]:
         """All committed (epoch, batch) records, oldest first

@@ -55,11 +55,24 @@ def main(argv=None) -> int:
         help="generate threshold keys by distributed key generation "
         "(ops.dkg) instead of the trusted dealer",
     )
+    ap.add_argument(
+        "--trace",
+        metavar="OUT_JSON",
+        default=None,
+        help="run under the flight recorder (utils/trace.py) and "
+        "write the merged Chrome-trace artifact here on exit — open "
+        "it at ui.perfetto.dev (grpc mode only; see docs/TRACING.md)",
+    )
     args = ap.parse_args(argv)
     configure_logging(logging.DEBUG if args.verbose else logging.INFO)
 
     cfg = Config(
-        n=args.n, batch_size=args.batch_size, crypto_backend=args.crypto
+        n=args.n,
+        batch_size=args.batch_size,
+        crypto_backend=args.crypto,
+        # tracing instruments the message-passing path only: lockstep
+        # mode must not pay for recorders nobody ever reads
+        trace=args.trace is not None and args.mode == "grpc",
     )
     ids = [f"node{i}" for i in range(args.n)]
     print(
@@ -68,6 +81,12 @@ def main(argv=None) -> int:
         + (" keys=dkg" if args.dkg else " keys=dealer")
     )
     if args.mode == "lockstep":
+        if args.trace:
+            print(
+                "== note: --trace instruments the message-passing "
+                "path; lockstep mode has no per-node timelines "
+                "(flag ignored)"
+            )
         return _lockstep_main(args, cfg)
     keys = setup_keys(cfg, ids)
     if args.dkg:
@@ -127,6 +146,21 @@ def main(argv=None) -> int:
 
     snap = watcher.node.metrics.snapshot()
     print(f"== node0 metrics: {snap}")
+    if args.trace:
+        from cleisthenes_tpu.utils.trace import write_chrome
+
+        events = {
+            i: h.node.trace.events()
+            for i, h in hosts.items()
+            if h.node.trace is not None
+        }
+        write_chrome(args.trace, events)
+        n_events = sum(len(e) for e in events.values())
+        print(
+            f"== trace: {n_events} events -> {args.trace} "
+            "(open at ui.perfetto.dev; validate/report with "
+            "python -m tools.tracetool)"
+        )
     for h in hosts.values():
         h.stop()
     ok = committed == set(txs)

@@ -215,6 +215,7 @@ class ACS:
         out,
         hub=None,
         coin_issue_sink=None,
+        trace=None,
     ) -> None:
         self.n = config.n
         self.f = config.f
@@ -249,6 +250,7 @@ class ACS:
                 member_ids=self.members,
                 out=out,
                 hub=hub,
+                trace=trace,
             )
             rbc.on_deliver = self._on_rbc_deliver
             self.rbcs[proposer] = rbc
@@ -265,6 +267,7 @@ class ACS:
                 bank=self.bank,
                 index=index,
                 coin_issue_sink=coin_issue_sink,
+                trace=trace,
             )
             bba.on_decide = self._on_bba_decide
             self.bbas[proposer] = bba

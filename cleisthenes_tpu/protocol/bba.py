@@ -111,6 +111,7 @@ class BBA:
         bank=None,
         index: Optional[int] = None,
         coin_issue_sink: Optional[Callable] = None,
+        trace=None,
     ) -> None:
         self.n = config.n
         self.f = config.f
@@ -145,6 +146,8 @@ class BBA:
             )
         self.hub = hub
         self.hub.register((owner, epoch), self)  # see rbc.py note
+        # flight recorder (None = tracing off; utils/trace.py)
+        self.trace = trace
 
         self.round = 0
         self.est: Optional[bool] = None
@@ -374,6 +377,14 @@ class BBA:
         if r.coin_share_sent or not self._aux_quorum():
             return
         r.coin_share_sent = True
+        if self.trace is not None:
+            self.trace.instant(
+                "coin",
+                "share_issue",
+                epoch=self.epoch,
+                proposer=self.proposer,
+                round=self.round,
+            )
         if self.coin_issue_sink is not None:
             # the drain batches every queued instance's issue into one
             # dispatch and calls broadcast_coin_share back
@@ -558,6 +569,15 @@ class BBA:
         if valid is None:
             return
         r.coin_value = self.coin.toss(self._coin_id(self.round), valid)
+        if self.trace is not None:
+            self.trace.instant(
+                "coin",
+                "reveal",
+                epoch=self.epoch,
+                proposer=self.proposer,
+                round=self.round,
+                value=bool(r.coin_value),
+            )
         if self.coin_rows is not None and self.index is not None:
             self.coin_rows.watch_off(self.index)
         self._maybe_advance()
@@ -584,6 +604,14 @@ class BBA:
             next_est = self.decided
         self.round += 1
         self.est = next_est
+        if self.trace is not None:
+            self.trace.instant(
+                "bba",
+                "round",
+                epoch=self.epoch,
+                proposer=self.proposer,
+                round=self.round,
+            )
         self._rounds[self.round] = _Round(self.coin.pub.threshold)
         self.bank.reset_row(self.index, self.round)
         self._broadcast_bval(self.round, next_est)
@@ -615,6 +643,15 @@ class BBA:
 
     def _decide(self, b: bool) -> None:
         self.decided = b
+        if self.trace is not None:
+            self.trace.instant(
+                "bba",
+                "decide",
+                epoch=self.epoch,
+                proposer=self.proposer,
+                round=self.round,
+                value=bool(b),
+            )
         if not self._term_sent:
             self._term_sent = True
             self.out.broadcast(

@@ -78,6 +78,17 @@ class SimulatedCluster:
             if shared_hub
             else None
         )
+        # tracing (Config.trace): a cluster-shared hub's flushes serve
+        # the whole roster, so they record on a dedicated "hub" track
+        # rather than any one node's timeline; per-node hubs
+        # (shared_hub=False) inherit their owner's recorder inside
+        # HoneyBadger.  tools/tracetool.py merges all tracks.
+        self.hub_trace = None
+        if hub is not None and self.config.trace:
+            from cleisthenes_tpu.utils.trace import maybe_recorder
+
+            self.hub_trace = maybe_recorder(self.config, "hub")
+            hub.trace = self.hub_trace
         # same rationale as dedup above: N in-proc nodes re-parse the
         # identical decrypted blobs; per-node deployments pass None.
         # Instance-scoped and shared across THIS cluster's nodes only
@@ -151,6 +162,34 @@ class SimulatedCluster:
             }
             assert len(lists) == 1, f"fork at epoch {e}"
         return depth
+
+    # -- observability (the flight-recorder surface) -----------------------
+
+    def trace_events(self) -> Dict[str, list]:
+        """Every node's trace buffer (plus the shared hub's, under
+        the key "hub"), for tools/tracetool.py merging.  Empty when
+        Config.trace is off."""
+        out: Dict[str, list] = {}
+        for nid, hb in self.nodes.items():
+            if hb.trace is not None:
+                out[nid] = hb.trace.events()
+        if self.hub_trace is not None:
+            out["hub"] = self.hub_trace.events()
+        return out
+
+    def write_trace(self, path: str) -> None:
+        """Write the merged Chrome-trace-event artifact (Perfetto-
+        loadable; see docs/TRACING.md).  Raises if tracing is off —
+        an empty artifact would silently hide a misconfiguration."""
+        events = self.trace_events()
+        if not events:
+            raise ValueError(
+                "no trace buffers: construct the cluster with "
+                "Config(trace=True)"
+            )
+        from cleisthenes_tpu.utils.trace import write_chrome
+
+        write_chrome(path, events)
 
     # -- fault injection (delegates to the network) ------------------------
 

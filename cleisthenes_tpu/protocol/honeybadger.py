@@ -52,6 +52,7 @@ from cleisthenes_tpu.protocol.acs import ACS
 from cleisthenes_tpu.utils.determinism import proposal_rng
 from cleisthenes_tpu.utils.log import NodeLogger
 from cleisthenes_tpu.utils.metrics import Metrics
+from cleisthenes_tpu.utils.trace import maybe_recorder
 from cleisthenes_tpu.transport.broadcast import CoalescingBroadcaster
 from cleisthenes_tpu.transport.message import (
     BbaBatchPayload,
@@ -417,13 +418,26 @@ class HoneyBadger:
         self.on_commit: Optional[Callable[[int, Batch], None]] = None
         self.metrics = Metrics()
         self.log = NodeLogger(node_id, "hb")
+        # flight recorder (utils/trace.py): None when Config.trace is
+        # off — every instrumentation site below guards on that, so
+        # the disabled path is one attribute load + identity check
+        self.trace = maybe_recorder(config, node_id)
+        if self.trace is not None:
+            self.metrics.set_trace_stats(self.trace.stats)
+            if hub is None:  # a private hub reports on our timeline
+                self.hub.trace = self.trace
+        # messages served since the last transport idle callback (the
+        # wave-size series the trace's "transport/wave" events carry)
+        self._trace_wave_msgs = 0
         # Outbound path: protocol payloads -> per-receiver coalescing
         # buffers -> (at wave boundaries) bundled envelopes on the
         # inner transport.  In self-draining mode (no transport idle
         # callback) buffers flush at the end of every entry point; a
         # transport that calls transport_manages_idle() moves flushing
         # to its quiescence point for whole-wave bundles.
-        self._coalesce = CoalescingBroadcaster(out, self.members)
+        self._coalesce = CoalescingBroadcaster(
+            out, self.members, trace=self.trace
+        )
         self._transport_managed = False
         self.out = _CountingBroadcaster(
             self._coalesce, self.metrics, len(self.members)
@@ -442,6 +456,8 @@ class HoneyBadger:
         # durable committed-batch log (core.ledger.BatchLog): restore
         # the committed history + epoch counter + dup-filter on restart
         self.batch_log = batch_log
+        if batch_log is not None and self.trace is not None:
+            batch_log.trace = self.trace  # WAL appends on our timeline
         self._commits_since_ckpt = 0
         if batch_log is not None and batch_log.last_epoch is not None:
             # seed the dup-filter from the last checkpoint (if any) and
@@ -503,8 +519,16 @@ class HoneyBadger:
                 return
             es.proposed = True
             self.metrics.epoch_proposed(target)
+            tr = self.trace
+            if tr is not None:
+                tr.instant("epoch", "open", epoch=target)
+            t0 = 0.0 if tr is None else tr.now()
             es.my_txs = self._create_batch()
             ct = self.tpke.encrypt(serialize_txs(es.my_txs))
+            if tr is not None:
+                tr.complete(
+                    "tpke", "encrypt", t0, epoch=target, txs=len(es.my_txs)
+                )
             es.acs.input(
                 serialize_ciphertext(ct, self.keys.tpke_pub.group)
             )
@@ -566,6 +590,12 @@ class HoneyBadger:
         """Transport idle callback: run the crypto flush the wave
         requested (quorum events only record the want in deferred
         mode), then ship everything it produced."""
+        tr = self.trace
+        if tr is not None and self._trace_wave_msgs:
+            # one wave boundary: how many envelopes this quiescence
+            # point absorbed (the dispatch-amortization denominator)
+            tr.instant("transport", "wave", msgs=self._trace_wave_msgs)
+            self._trace_wave_msgs = 0
         self._drain_coin_issues()
         self.hub.run_deferred()
         # the flush itself can advance rounds and queue NEW coin
@@ -595,6 +625,8 @@ class HoneyBadger:
         pend = self._pending_coin_issues
         if not pend:
             return
+        tr = self.trace
+        t0 = 0.0 if tr is None else tr.now()
         self._pending_coin_issues = []
         group = self.keys.coin_pub.group
         vks = self.keys.coin_pub.verification_keys
@@ -619,11 +651,15 @@ class HoneyBadger:
         )
         for (bba, rnd), share in zip(metas, shares):
             bba.broadcast_coin_share(rnd, share)
+        if tr is not None:
+            tr.complete("coin", "issue_batch", t0, n=len(items))
 
     # -- message demux (transport Handler) ---------------------------------
 
     def serve_request(self, msg: Message) -> None:
         try:
+            if self.trace is not None:
+                self._trace_wave_msgs += 1
             payload = msg.payload
             if isinstance(payload, BundlePayload):
                 items = payload.items
@@ -720,6 +756,7 @@ class HoneyBadger:
                 out=self.out,
                 hub=self.hub,
                 coin_issue_sink=self._queue_coin_issue,
+                trace=self.trace,
             )
             acs.on_output = self._on_acs_output
             es = _EpochState(acs)
@@ -734,6 +771,11 @@ class HoneyBadger:
             return
         es.output = output
         self.metrics.epoch_acs_output(epoch)
+        tr = self.trace
+        if tr is not None:
+            tr.instant(
+                "epoch", "acs_output", epoch=epoch, proposers=len(output)
+            )
         # Epoch pipelining (BASELINE config 5): this epoch has entered
         # its decryption-share phase — overlap it with the NEXT epoch's
         # proposal (RS encode + Merkle forest + VAL/ECHO round trips).
@@ -744,6 +786,9 @@ class HoneyBadger:
             and len(self.que) > 0
         ):
             self.start_epoch(epoch + 1)
+        # span start AFTER the pipelined next-epoch proposal: the
+        # share-issue stage must not absorb epoch e+1's encode time
+        t_share0 = 0.0 if tr is None else tr.now()
         for proposer, ct_bytes in output.items():
             try:
                 ct = deserialize_ciphertext(
@@ -765,6 +810,14 @@ class HoneyBadger:
                     e=share.e,
                     z=share.z,
                 )
+            )
+        if tr is not None:
+            tr.complete(
+                "tpke",
+                "dec_share_issue",
+                t_share0,
+                epoch=epoch,
+                ciphertexts=len(es.ciphertexts),
             )
         for proposer in list(es.ciphertexts):
             self._try_decrypt(epoch, es, proposer)
@@ -869,6 +922,8 @@ class HoneyBadger:
                 es.opt_short.add(proposer)
                 return
             es.opt_short.discard(proposer)
+            tr = self.trace
+            t0 = 0.0 if tr is None else tr.now()
             try:
                 plain = self.tpke.combine(ct, subset)
             except ValueError:  # bad tag: an invalid share slipped in
@@ -876,6 +931,10 @@ class HoneyBadger:
                 self.hub.mark_dirty(self)
                 self.hub.request_flush()
                 return
+            if tr is not None:
+                tr.complete(
+                    "tpke", "combine", t0, epoch=epoch, proposer=proposer
+                )
             try:
                 es.decrypted[proposer] = deserialize_txs(
                     plain, self._tx_parse_memo
@@ -972,6 +1031,8 @@ class HoneyBadger:
         if not force and self._last_catchup_request == self.epoch:
             return  # one broadcast per frontier (re-fired as we adopt)
         self._last_catchup_request = self.epoch
+        if self.trace is not None:
+            self.trace.instant("catchup", "request", from_epoch=self.epoch)
         self.out.broadcast(CatchupReqPayload(from_epoch=self.epoch))
 
     def _handle_catchup_req(
@@ -1006,6 +1067,10 @@ class HoneyBadger:
         )
         from cleisthenes_tpu.core.ledger import encode_batch_body
 
+        if self.trace is not None:
+            self.trace.instant(
+                "catchup", "serve", from_epoch=start, epochs=end - start
+            )
         # one response per missed epoch; the coalescing broadcaster
         # bundles the run into a single envelope for the requester
         for epoch in range(start, end):
@@ -1093,6 +1158,10 @@ class HoneyBadger:
         """Commit a batch learned via CATCHUP instead of running the
         (long-gone) epoch ourselves."""
         self.log.info("adopted catch-up batch", epoch=epoch, txs=len(batch))
+        if self.trace is not None:
+            self.trace.instant(
+                "catchup", "adopt", epoch=epoch, txs=len(batch)
+            )
         self.committed_batches.append(batch)
         seen = set(batch.tx_list())
         self._remember_committed(seen)
@@ -1145,6 +1214,10 @@ class HoneyBadger:
         batch = Batch(contributions=contributions)
         self.committed_batches.append(batch)
         self.metrics.epoch_committed(epoch, len(batch))
+        if self.trace is not None:
+            self.trace.instant(
+                "epoch", "commit", epoch=epoch, txs=len(batch)
+            )
         if self.batch_log is not None:
             self.batch_log.append(epoch, batch)
         self.log.debug("committed", epoch=epoch, txs=len(batch))

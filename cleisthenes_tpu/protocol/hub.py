@@ -151,6 +151,11 @@ class CryptoHub:
         self.decode_items = 0
         self.share_items = 0
         self.dispatches = 0
+        # flight recorder (utils/trace.py).  Per-node hubs inherit
+        # the owner's recorder; a cluster-SHARED hub gets its own
+        # "hub" track (its flushes serve the whole roster and belong
+        # to no single node's timeline).  None = tracing off.
+        self.trace = None
 
     # -- membership --------------------------------------------------------
 
@@ -206,6 +211,14 @@ class CryptoHub:
         self._flushing = True
         self.flush_wanted = False  # any full flush satisfies the want
         self.flushes += 1
+        tr = self.trace
+        t0 = 0.0 if tr is None else tr.now()
+        d0, b0, k0, s0 = (
+            self.dispatches,
+            self.branch_items,
+            self.decode_items,
+            self.share_items,
+        )
         try:
             for _ in range(MAX_FLUSH_ROUNDS):
                 if not self._dirty:
@@ -232,6 +245,16 @@ class CryptoHub:
                     c.after_crypto_flush()
         finally:
             self._flushing = False
+            if tr is not None:
+                tr.complete(
+                    "hub",
+                    "flush",
+                    t0,
+                    dispatches=self.dispatches - d0,
+                    branches=self.branch_items - b0,
+                    decodes=self.decode_items - k0,
+                    shares=self.share_items - s0,
+                )
 
     # -- executors ---------------------------------------------------------
 

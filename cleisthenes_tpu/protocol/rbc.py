@@ -75,6 +75,7 @@ class RBC:
         member_ids: Sequence[str],
         out,
         hub=None,
+        trace=None,
     ) -> None:
         self.n = config.n
         self.f = config.f
@@ -98,6 +99,8 @@ class RBC:
         # in-proc validators (cluster-batched dispatches), and one
         # node advancing epochs must only drop ITS clients
         self.hub.register((owner, epoch), self)
+        # flight recorder (None = tracing off; utils/trace.py)
+        self.trace = trace
 
         # hook set by ACS: fn(proposer_id, value_bytes)
         self.on_deliver: Optional[Callable[[str, bytes], None]] = None
@@ -159,10 +162,16 @@ class RBC:
                 f"value of {len(value)} bytes exceeds the "
                 f"{self.k} x {MAX_SHARD_BYTES}-byte shard capacity"
             )
+        tr = self.trace
+        t0 = 0.0 if tr is None else tr.now()
         data = split_payload(value, self.k)
         shards = self.crypto.erasure.encode(data)  # (n, L)
         tree = self.crypto.merkle.build(shards)
         root = tree.root
+        if tr is not None:
+            tr.complete(
+                "rbc", "propose", t0, epoch=self.epoch, bytes=len(value)
+            )
         for j, member in enumerate(self.members):
             payload = RbcPayload(
                 type=RbcType.VAL,
@@ -260,6 +269,10 @@ class RBC:
         # verified: this length is now the root's authoritative one
         self._shard_len.setdefault(payload.root_hash, len(payload.shard))
         self._echo_sent = True
+        if self.trace is not None:
+            self.trace.instant(
+                "rbc", "val", epoch=self.epoch, proposer=self.proposer
+            )
         self.out.broadcast(
             RbcPayload(
                 type=RbcType.ECHO,
@@ -352,6 +365,12 @@ class RBC:
 
     def _send_ready(self, root: bytes) -> None:
         self._ready_root = root
+        if self.trace is not None:
+            # fires at most once per instance (_ready_root gates every
+            # caller): the READY quorum-crossing marker
+            self.trace.instant(
+                "rbc", "ready", epoch=self.epoch, proposer=self.proposer
+            )
         self.out.broadcast(
             RbcPayload(
                 type=RbcType.READY,
@@ -371,6 +390,14 @@ class RBC:
         ):
             return
         self._decode_req.add(root)
+        if self.trace is not None:
+            # the ECHO-quorum crossing: a decode+recheck became wanted
+            self.trace.instant(
+                "rbc",
+                "echo_quorum",
+                epoch=self.epoch,
+                proposer=self.proposer,
+            )
         self.hub.mark_dirty(self)
 
     def _maybe_deliver(self, root: bytes) -> None:
@@ -393,6 +420,14 @@ class RBC:
             if value is None:
                 return
         self._value = value
+        if self.trace is not None:
+            self.trace.instant(
+                "rbc",
+                "deliver",
+                epoch=self.epoch,
+                proposer=self.proposer,
+                bytes=len(value),
+            )
         # free per-root buffers; the instance is terminal now
         self._shards.clear()
         self._echo_senders.clear()

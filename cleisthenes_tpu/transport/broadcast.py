@@ -184,7 +184,7 @@ class CoalescingBroadcaster:
     transport.base.Authenticator.sign_wire_many).
     """
 
-    def __init__(self, inner, member_ids: Sequence[str]) -> None:
+    def __init__(self, inner, member_ids: Sequence[str], trace=None) -> None:
         self._inner = inner
         self._members: List[str] = sorted(member_ids)
         self._buffers: Dict[str, List[Payload]] = {
@@ -194,6 +194,10 @@ class CoalescingBroadcaster:
         self._broadcast_only = True  # no send_to since last flush
         self.bundles_flushed = 0
         self.payloads_buffered = 0
+        # flight recorder (utils/trace.py): each flush records one
+        # "transport/flush" span covering fold + envelope encode + MAC
+        # + post for the wave.  None = tracing off.
+        self.trace = trace
 
     def broadcast(self, payload: Payload) -> None:
         for m in self._members:
@@ -225,6 +229,25 @@ class CoalescingBroadcaster:
         retries instead of silently stranding a wave's bundles."""
         if not self._dirty:
             return
+        tr = self.trace
+        if tr is None:
+            self._flush_dirty()
+            return
+        t0 = tr.now()
+        bundles0 = self.bundles_flushed
+        payloads = sum(len(b) for b in self._buffers.values())
+        try:
+            self._flush_dirty()
+        finally:
+            tr.complete(
+                "transport",
+                "flush",
+                t0,
+                bundles=self.bundles_flushed - bundles0,
+                payloads=payloads,
+            )
+
+    def _flush_dirty(self) -> None:
         self._dirty = False
         broadcast_only = self._broadcast_only
         self._broadcast_only = True

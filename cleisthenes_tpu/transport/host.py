@@ -55,6 +55,10 @@ class SerialDispatcher:
         self._q: "queue.Queue" = queue.Queue()
         self._handler = None
         self._on_idle = None
+        # flight recorder (utils/trace.py), set by the owning host
+        # AFTER construction; only the worker thread records (the
+        # producer-side serve_request never touches it).  None = off.
+        self.trace = None
         self._thread = threading.Thread(
             target=self._loop, name=name, daemon=True
         )
@@ -104,6 +108,8 @@ class SerialDispatcher:
         self.call_sync(lambda: None, timeout=timeout)
 
     def _loop(self) -> None:
+        served = 0
+        depth_peak = 0
         while not self._stopped.is_set():
             item = self._q.get()
             if item is None:
@@ -118,6 +124,26 @@ class SerialDispatcher:
                 import traceback
 
                 traceback.print_exc()
+            tr = self.trace
+            if tr is not None:
+                served += 1
+                # backlog BEHIND the item just processed: the depth
+                # signal (at the drain point itself it is 0 by
+                # definition, so sample per item and report the peak)
+                backlog = self._q.qsize()
+                if backlog > depth_peak:
+                    depth_peak = backlog
+                if backlog == 0:
+                    # mailbox drained: one wave's worth of items plus
+                    # the deepest backlog observed during the wave
+                    tr.instant(
+                        "transport",
+                        "queue_depth",
+                        msgs=served,
+                        depth=depth_peak,
+                    )
+                    served = 0
+                    depth_peak = 0
             if self._on_idle is not None and self._q.empty():
                 # mailbox drained: wave boundary (a racing producer
                 # just means an extra flush later — never a lost one,
@@ -264,6 +290,9 @@ class ValidatorHost:
             batch_log=batch_log,
         )
         self.node.metrics.set_transport_health(self.health.snapshot)
+        # the dispatcher records queue-depth/wave events on the node's
+        # own timeline (same worker thread as all protocol code)
+        self.dispatcher.trace = self.node.trace
         self.dispatcher.bind(self.node)
         self._commits: "queue.Queue" = queue.Queue()
         self.node.on_commit = lambda epoch, batch: self._commits.put(

@@ -7,12 +7,15 @@ from cleisthenes_tpu.utils.metrics import (
     Histogram,
     Metrics,
 )
+from cleisthenes_tpu.utils.trace import TraceRecorder, maybe_recorder
 
 __all__ = [
     "Counter",
     "Histogram",
     "EpochTrace",
     "Metrics",
+    "TraceRecorder",
     "guarded_by",
+    "maybe_recorder",
     "proposal_rng",
 ]

@@ -137,11 +137,20 @@ class Metrics:
         # .snapshot, set by the host that owns the dial layer): folds a
         # per-peer UP/DEGRADED/DOWN block into snapshot()
         self._transport_health: Optional[Callable[[], Dict]] = None
+        # flight-recorder stats provider (utils.trace.TraceRecorder
+        # .stats, set by the node when Config.trace is on): folds the
+        # {events_recorded, events_dropped, high_water} block in
+        self._trace_stats: Optional[Callable[[], Dict]] = None
 
     def set_transport_health(
         self, provider: Optional[Callable[[], Dict]]
     ) -> None:
         self._transport_health = provider
+
+    def set_trace_stats(
+        self, provider: Optional[Callable[[], Dict]]
+    ) -> None:
+        self._trace_stats = provider
 
     def trace(self, epoch: int) -> EpochTrace:
         with self._lock:
@@ -193,6 +202,8 @@ class Metrics:
         }
         if self._transport_health is not None:
             out["transport_health"] = self._transport_health()
+        if self._trace_stats is not None:
+            out["trace"] = self._trace_stats()
         return out
 
 

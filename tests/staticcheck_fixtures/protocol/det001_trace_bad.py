@@ -1,0 +1,21 @@
+"""Known-bad: a raw ``perf_counter`` in protocol code still gates
+even with the tracing subsystem landed.
+
+The observability plane's contract (docs/ARCHITECTURE.md) is that
+trace timestamps come from ``utils.trace.TraceRecorder`` — the ONE
+file carrying the ``allow[DET001]`` pragma — and protocol code calls
+``recorder.now()`` / ``recorder.instant()``.  Inlining the clock here
+must keep firing DET001: the pragma is confined to utils/trace.py,
+not granted to the plane.
+"""
+
+import time
+
+
+def record_epoch_open(events, epoch):
+    # hand-rolled instrumentation instead of the recorder seam
+    events.append(("open", epoch, time.perf_counter()))  # BAD:DET001
+
+
+def record_epoch_commit(events, epoch):
+    events.append(("commit", epoch, time.perf_counter_ns()))  # BAD:DET001

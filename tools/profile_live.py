@@ -39,7 +39,9 @@ def main() -> int:
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 64
     batch = int(sys.argv[2]) if len(sys.argv) > 2 else 1024
     with benchlock.hold("profile_live"):
-        cfg, net, nodes = bench.build_network("cpu", n=n, batch=batch)
+        cfg, net, nodes, _cluster = bench.build_network(
+            "cpu", n=n, batch=batch
+        )
         rng = np.random.default_rng(13)
         node_ids = sorted(nodes)
         for i in range(batch * 2):

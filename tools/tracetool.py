@@ -1,0 +1,441 @@
+"""tracetool: merge, validate and explain flight-recorder artifacts.
+
+The recorder half lives in `cleisthenes_tpu/utils/trace.py` (per-node
+bounded rings, merged into one Chrome-trace-event JSON by
+`to_chrome`); this tool is the analysis half:
+
+- ``--validate``  schema gate: every event carries a known category,
+  a name, timestamps, and a per-track ``seq`` that increases strictly
+  monotonically (sequence numbers are the determinism-plane ordering
+  truth; timestamps are observability-only).  The ci.sh observability
+  stage pipes a freshly captured seeded-cluster artifact through this.
+- ``--report`` (default)  per-epoch critical-path attribution: the
+  wall time from the earliest ``epoch/open`` to the latest
+  ``epoch/commit`` is tiled by the merged event timeline — each gap is
+  attributed to the stage (category) of the event that TERMINATES it,
+  which in the serialized in-proc cluster is literally "what the run
+  was computing toward next".  Prints per-epoch stage shares, the
+  longest chain segments, and a summary table (hub dispatch counts by
+  class, wave sizes, p50/p95 span durations).
+- ``--capture OUT``  runs a seeded N-node SimulatedCluster with
+  tracing on and writes the merged artifact — the self-contained
+  source of CI fixtures and quick local looks.
+
+Open artifacts interactively at https://ui.perfetto.dev ("Open trace
+file"); one track per node, spans nested by category.  Schema details:
+docs/TRACING.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import bisect
+import json
+import operator
+import pathlib
+import sys
+from typing import Dict, List, Optional, Tuple
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from cleisthenes_tpu.utils.trace import CATEGORIES  # noqa: E402
+
+_ALLOWED_PH = frozenset(("M", "X", "i"))
+
+
+# ---------------------------------------------------------------------------
+# loading & validation
+# ---------------------------------------------------------------------------
+
+
+def load(path: str) -> dict:
+    with open(path, "r", encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+def track_names(doc: dict) -> Dict[int, str]:
+    """tid -> node name from the thread_name metadata events."""
+    out: Dict[int, str] = {}
+    for ev in doc.get("traceEvents", ()):
+        if ev.get("ph") == "M" and ev.get("name") == "thread_name":
+            out[ev.get("tid", 0)] = str(ev.get("args", {}).get("name", ""))
+    return out
+
+
+def validate(doc: dict) -> List[str]:
+    """Schema + per-track monotone-sequence check; [] means valid."""
+    errors: List[str] = []
+    events = doc.get("traceEvents")
+    if not isinstance(events, list):
+        return ["artifact has no traceEvents list"]
+    if not events:
+        return ["traceEvents is empty"]
+    last_seq: Dict[int, int] = {}
+    names = track_names(doc)
+    for i, ev in enumerate(events):
+        where = f"traceEvents[{i}]"
+        if not isinstance(ev, dict):
+            errors.append(f"{where}: not an object")
+            continue
+        ph = ev.get("ph")
+        if ph not in _ALLOWED_PH:
+            errors.append(f"{where}: unknown ph {ph!r}")
+            continue
+        if ph == "M":
+            continue
+        cat = ev.get("cat")
+        if cat not in CATEGORIES:
+            errors.append(f"{where}: unknown category {cat!r}")
+        if not isinstance(ev.get("name"), str) or not ev["name"]:
+            errors.append(f"{where}: missing event name")
+        ts = ev.get("ts")
+        if not isinstance(ts, (int, float)) or ts < 0:
+            errors.append(f"{where}: bad ts {ts!r}")
+        if ph == "X":
+            dur = ev.get("dur")
+            if not isinstance(dur, (int, float)) or dur < 0:
+                errors.append(f"{where}: X event with bad dur {dur!r}")
+        args = ev.get("args")
+        if not isinstance(args, dict):
+            errors.append(f"{where}: missing args")
+            continue
+        seq = args.get("seq")
+        tid = ev.get("tid")
+        if not isinstance(seq, int) or seq < 1:
+            errors.append(f"{where}: bad args.seq {seq!r}")
+            continue
+        if tid in last_seq and seq <= last_seq[tid]:
+            node = names.get(tid, tid)
+            errors.append(
+                f"{where}: seq {seq} not after {last_seq[tid]} on "
+                f"track {node!r} (per-node sequence must be "
+                "strictly increasing)"
+            )
+        last_seq[tid] = seq
+    return errors
+
+
+# ---------------------------------------------------------------------------
+# per-epoch critical-path attribution
+# ---------------------------------------------------------------------------
+
+
+def _analysis_events(doc: dict) -> List[dict]:
+    return [
+        ev
+        for ev in doc.get("traceEvents", ())
+        if ev.get("ph") in ("X", "i")
+    ]
+
+
+def _point(ev: dict) -> float:
+    """The instant an event 'happened': span END for X events (when
+    the work finished), ts for instants."""
+    return float(ev["ts"]) + float(ev.get("dur", 0.0))
+
+
+def epoch_windows(doc: dict) -> Dict[int, Tuple[float, float]]:
+    """epoch -> (us of earliest open, us of latest commit), for every
+    epoch with both markers."""
+    opens: Dict[int, float] = {}
+    commits: Dict[int, float] = {}
+    for ev in _analysis_events(doc):
+        if ev.get("cat") != "epoch":
+            continue
+        epoch = ev.get("args", {}).get("epoch")
+        if not isinstance(epoch, int):
+            continue
+        ts = float(ev["ts"])
+        if ev["name"] == "open":
+            if epoch not in opens or ts < opens[epoch]:
+                opens[epoch] = ts
+        elif ev["name"] == "commit":
+            if epoch not in commits or ts > commits[epoch]:
+                commits[epoch] = ts
+    return {
+        e: (opens[e], commits[e])
+        for e in sorted(opens)
+        if e in commits and commits[e] > opens[e]
+    }
+
+
+def sorted_points(doc: dict) -> List[Tuple[float, str, str, int]]:
+    """All event completion points (point_us, cat, name, tid), sorted
+    once — epoch windows slice into this via bisect, so analyzing E
+    (possibly overlapping, under pipelining) epochs costs one sort,
+    not E re-sorts of the whole artifact."""
+    return sorted(
+        (
+            (_point(ev), ev["cat"], ev["name"], ev.get("tid", 0))
+            for ev in _analysis_events(doc)
+        ),
+        key=operator.itemgetter(0),
+    )
+
+
+def attribute_epoch(
+    doc: dict,
+    t_open: float,
+    t_commit: float,
+    points: Optional[List[Tuple[float, str, str, int]]] = None,
+) -> Tuple[Dict[str, float], List[Tuple[float, str, str, int]]]:
+    """Tile [t_open, t_commit] by the merged timeline.
+
+    Returns (shares, chain): ``shares`` maps category -> attributed
+    microseconds (summing to exactly the window — every gap ends at
+    some recorded event, and the closing commit is itself an event);
+    ``chain`` is the gap list (gap_us, cat, name, tid) in time order —
+    its largest entries are the epoch's critical-path segments.
+
+    ``points`` is the precomputed ``sorted_points(doc)`` list; pass it
+    when analyzing many windows of one artifact.
+    """
+    if points is None:
+        points = sorted_points(doc)
+    key = operator.itemgetter(0)
+    lo = bisect.bisect_right(points, t_open, key=key)
+    hi = bisect.bisect_right(points, t_commit, key=key)
+    shares: Dict[str, float] = {}
+    chain: List[Tuple[float, str, str, int]] = []
+    prev = t_open
+    for point, cat, name, tid in points[lo:hi]:
+        gap = point - prev
+        if gap > 0:
+            shares[cat] = shares.get(cat, 0.0) + gap
+            chain.append((gap, cat, name, tid))
+        prev = point
+    # anything after the last recorded point (can only happen in a
+    # degenerate artifact where commit was dropped by ring overflow)
+    tail = t_commit - prev
+    if tail > 0:
+        shares["epoch"] = shares.get("epoch", 0.0) + tail
+        chain.append((tail, "epoch", "(untraced tail)", 0))
+    return shares, chain
+
+
+def stage_shares(doc: dict) -> Dict[str, float]:
+    """Whole-run per-stage fractions of total epoch wall time — the
+    bench.py --trace breakdown (fractions sum to ~1.0)."""
+    windows = epoch_windows(doc)
+    points = sorted_points(doc)
+    totals: Dict[str, float] = {}
+    wall = 0.0
+    for t_open, t_commit in windows.values():
+        shares, _chain = attribute_epoch(doc, t_open, t_commit, points)
+        for cat, us in shares.items():
+            totals[cat] = totals.get(cat, 0.0) + us
+        wall += t_commit - t_open
+    if wall <= 0:
+        return {}
+    return {
+        cat: round(us / wall, 4) for cat, us in sorted(totals.items())
+    }
+
+
+# ---------------------------------------------------------------------------
+# summary tables
+# ---------------------------------------------------------------------------
+
+
+def _percentile(values: List[float], p: float) -> Optional[float]:
+    if not values:
+        return None
+    vs = sorted(values)
+    idx = min(len(vs) - 1, int(round((p / 100.0) * (len(vs) - 1))))
+    return vs[idx]
+
+
+def summarize(doc: dict) -> dict:
+    """Counts + distributions: hub dispatch classes, wave sizes,
+    span-duration percentiles, event counts by category."""
+    by_cat: Dict[str, int] = {}
+    span_durs: Dict[Tuple[str, str], List[float]] = {}
+    wave_sizes: List[float] = []
+    hub = {"flushes": 0, "dispatches": 0, "branches": 0, "decodes": 0,
+           "shares": 0}
+    for ev in _analysis_events(doc):
+        cat = ev["cat"]
+        by_cat[cat] = by_cat.get(cat, 0) + 1
+        if ev["ph"] == "X":
+            span_durs.setdefault((cat, ev["name"]), []).append(
+                float(ev.get("dur", 0.0))
+            )
+        args = ev.get("args", {})
+        if cat == "hub" and ev["name"] == "flush":
+            hub["flushes"] += 1
+            for k in ("dispatches", "branches", "decodes", "shares"):
+                hub[k] += int(args.get(k, 0))
+        elif cat == "transport" and ev["name"] in ("wave", "queue_depth"):
+            msgs = args.get("msgs")
+            if isinstance(msgs, (int, float)):
+                wave_sizes.append(float(msgs))
+    spans = {
+        f"{cat}/{name}": {
+            "n": len(durs),
+            "p50_us": round(_percentile(durs, 50), 1),
+            "p95_us": round(_percentile(durs, 95), 1),
+        }
+        for (cat, name), durs in sorted(span_durs.items())
+    }
+    return {
+        "events_by_category": dict(sorted(by_cat.items())),
+        "hub": hub,
+        "wave_size_p50": _percentile(wave_sizes, 50),
+        "wave_size_p95": _percentile(wave_sizes, 95),
+        "spans": spans,
+    }
+
+
+def report(doc: dict, top: int = 5) -> str:
+    """The human-readable critical-path report."""
+    names = track_names(doc)
+    lines: List[str] = []
+    windows = epoch_windows(doc)
+    points = sorted_points(doc)
+    if not windows:
+        lines.append("no complete epochs (open+commit) in the artifact")
+    for epoch, (t_open, t_commit) in windows.items():
+        wall = t_commit - t_open
+        shares, chain = attribute_epoch(doc, t_open, t_commit, points)
+        covered = sum(shares.values())
+        lines.append(
+            f"epoch {epoch}: wall {wall / 1000.0:.3f} ms, "
+            f"{100.0 * covered / wall:.1f}% attributed"
+        )
+        for cat, us in sorted(
+            shares.items(), key=lambda kv: -kv[1]
+        ):
+            lines.append(
+                f"  {cat:<10} {us / 1000.0:>10.3f} ms "
+                f"({100.0 * us / wall:5.1f}%)"
+            )
+        lines.append("  critical-path segments (longest first):")
+        for gap, cat, name, tid in sorted(chain, key=lambda c: -c[0])[
+            :top
+        ]:
+            lines.append(
+                f"    {gap / 1000.0:>9.3f} ms -> {cat}/{name} "
+                f"@ {names.get(tid, tid)}"
+            )
+    s = summarize(doc)
+    lines.append("summary:")
+    lines.append(f"  events by category: {s['events_by_category']}")
+    lines.append(f"  hub: {s['hub']}")
+    lines.append(
+        f"  wave size p50/p95: {s['wave_size_p50']}/{s['wave_size_p95']}"
+    )
+    for span, st in s["spans"].items():
+        lines.append(
+            f"  span {span:<22} n={st['n']:<5} "
+            f"p50={st['p50_us']}us p95={st['p95_us']}us"
+        )
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# capture: a seeded traced cluster in one command (the CI fixture)
+# ---------------------------------------------------------------------------
+
+
+def capture(
+    out_path: str,
+    n: int = 4,
+    seed: int = 7,
+    txs: int = 24,
+    batch: int = 8,
+) -> dict:
+    """Run a seeded N-node SimulatedCluster with tracing on, write the
+    merged artifact, and return the loaded document."""
+    from cleisthenes_tpu.config import Config
+    from cleisthenes_tpu.protocol.cluster import SimulatedCluster
+
+    cluster = SimulatedCluster(
+        config=Config(n=n, batch_size=batch, seed=seed, trace=True),
+        seed=seed,
+        key_seed=1,
+    )
+    for i in range(txs):
+        cluster.submit(b"trace-tx-%04d" % i)
+    cluster.run_epochs()
+    cluster.assert_agreement()
+    cluster.write_trace(out_path)
+    return load(out_path)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="tools.tracetool")
+    ap.add_argument(
+        "artifact",
+        nargs="?",
+        help="merged Chrome-trace JSON (from SimulatedCluster."
+        "write_trace, demo.py --trace, or --capture)",
+    )
+    ap.add_argument(
+        "--validate",
+        action="store_true",
+        help="schema + per-track monotone-seq gate (exit 1 on errors)",
+    )
+    ap.add_argument(
+        "--report",
+        action="store_true",
+        help="critical-path + summary report (the default action)",
+    )
+    ap.add_argument(
+        "--json",
+        action="store_true",
+        help="emit stage shares + summary as one JSON object",
+    )
+    ap.add_argument(
+        "--capture",
+        metavar="OUT",
+        help="run a seeded traced cluster and write the artifact here",
+    )
+    ap.add_argument("--n", type=int, default=4)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--txs", type=int, default=24)
+    ap.add_argument("--batch", type=int, default=8)
+    args = ap.parse_args(argv)
+
+    if args.capture:
+        doc = capture(
+            args.capture,
+            n=args.n,
+            seed=args.seed,
+            txs=args.txs,
+            batch=args.batch,
+        )
+        n_events = sum(1 for _ in _analysis_events(doc))
+        print(
+            f"tracetool: captured {n_events} events from a seeded "
+            f"{args.n}-node cluster -> {args.capture}"
+        )
+        return 0
+    if not args.artifact:
+        ap.error("need an artifact path (or --capture OUT)")
+    doc = load(args.artifact)
+    if args.validate:
+        errors = validate(doc)
+        for e in errors:
+            print(e)
+        n_events = sum(1 for _ in _analysis_events(doc))
+        print(
+            f"tracetool: {n_events} events, {len(errors)} schema "
+            f"problem(s)"
+        )
+        return 1 if errors else 0
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "stage_shares": stage_shares(doc),
+                    "summary": summarize(doc),
+                }
+            )
+        )
+        return 0
+    print(report(doc))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
