@@ -5,11 +5,13 @@ cannot host — lives here as host-side message-driven state machines,
 mirroring the reference's actor design (reference rbc/rbc.go,
 bba/bba.go, honeybadger.go).  All O(N^2) crypto math is delegated to
 the batched ops plane (cleisthenes_tpu.ops) through the BatchCrypto
-seam.
+seam.  The plane's own adversary — semantic Byzantine behaviors under
+valid MACs — lives in protocol.byzantine (docs/FAULTS.md).
 """
 
 from cleisthenes_tpu.protocol.acs import ACS
 from cleisthenes_tpu.protocol.bba import BBA
+from cleisthenes_tpu.protocol.byzantine import Behavior, make_behavior
 from cleisthenes_tpu.protocol.cluster import SimulatedCluster
 from cleisthenes_tpu.protocol.honeybadger import (
     HoneyBadger,
@@ -28,4 +30,6 @@ __all__ = [
     "setup_keys",
     "SimulatedCluster",
     "LockstepCluster",
+    "Behavior",
+    "make_behavior",
 ]

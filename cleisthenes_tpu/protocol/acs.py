@@ -216,6 +216,7 @@ class ACS:
         hub=None,
         coin_issue_sink=None,
         trace=None,
+        metrics=None,
     ) -> None:
         self.n = config.n
         self.f = config.f
@@ -237,7 +238,7 @@ class ACS:
         # (protocol.votebank)
         from cleisthenes_tpu.protocol.votebank import VoteBank
 
-        self.bank = VoteBank(self.members, config.f)
+        self.bank = VoteBank(self.members, config.f, metrics=metrics)
         self.rbcs: Dict[str, RBC] = {}
         self.bbas: Dict[str, BBA] = {}
         for index, proposer in enumerate(self.members):
@@ -251,6 +252,7 @@ class ACS:
                 out=out,
                 hub=hub,
                 trace=trace,
+                metrics=metrics,
             )
             rbc.on_deliver = self._on_rbc_deliver
             self.rbcs[proposer] = rbc
@@ -268,6 +270,7 @@ class ACS:
                 index=index,
                 coin_issue_sink=coin_issue_sink,
                 trace=trace,
+                metrics=metrics,
             )
             bba.on_decide = self._on_bba_decide
             self.bbas[proposer] = bba

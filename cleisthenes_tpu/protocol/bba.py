@@ -112,6 +112,7 @@ class BBA:
         index: Optional[int] = None,
         coin_issue_sink: Optional[Callable] = None,
         trace=None,
+        metrics=None,
     ) -> None:
         self.n = config.n
         self.f = config.f
@@ -123,7 +124,10 @@ class BBA:
         if bank is None:  # standalone use (unit tests): private row
             from cleisthenes_tpu.protocol.votebank import VoteBank
 
-            bank = VoteBank(self.members, config.f, inst_ids=[proposer])
+            bank = VoteBank(
+                self.members, config.f, inst_ids=[proposer],
+                metrics=metrics,
+            )
             index = 0
         self.bank = bank
         self.index = index
@@ -148,6 +152,9 @@ class BBA:
         self.hub.register((owner, epoch), self)  # see rbc.py note
         # flight recorder (None = tracing off; utils/trace.py)
         self.trace = trace
+        # owner-node metrics (None in standalone unit tests): only the
+        # duplicate-vote absorption counter is touched here
+        self.metrics = metrics
 
         self.round = 0
         self.est: Optional[bool] = None
@@ -429,6 +436,8 @@ class BBA:
             if len(r.coin_shares) >= self._coin_threshold:
                 self.hub.mark_dirty(self)
                 self._maybe_reveal_coin()
+        elif self.metrics is not None:
+            self.metrics.dedup_absorbed.inc()
 
     def _maybe_reveal_coin(self) -> None:
         """Threshold reached -> flush the hub: OUR shares verify in the
@@ -668,6 +677,8 @@ class BBA:
 
     def _handle_term(self, sender: str, value: bool) -> None:
         if sender in self._term_voted:
+            if self.metrics is not None:
+                self.metrics.dedup_absorbed.inc()
             return
         self._term_voted.add(sender)
         self._term_recv[value].add(sender)

@@ -19,12 +19,16 @@ where per-dispatch round-trips dominate).  ``shared_hub=False``
 reverts to per-node hubs, the shape of a real multi-host deployment.
 
 Fault injection passes straight through to the network: ``crash``,
-``partition``, ``fault_filter`` (utils.adversary.Coalition).
+``partition``, ``fault_filter`` (utils.adversary.Coalition), plus the
+SEMANTIC adversary seam: ``behaviors={node_id: Behavior}`` mounts
+protocol-level malicious behaviors (protocol.byzantine — equivocation,
+split voting, share forgery...) on chosen nodes, composable with the
+wire-level filters on the same run.
 """
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence
 
 from cleisthenes_tpu.config import Config
 from cleisthenes_tpu.core.batch import Batch
@@ -34,6 +38,45 @@ from cleisthenes_tpu.protocol.hub import CryptoHub
 from cleisthenes_tpu.transport.base import HmacAuthenticator
 from cleisthenes_tpu.transport.broadcast import ChannelBroadcaster
 from cleisthenes_tpu.transport.channel import ChannelNetwork
+
+
+def run_until_drained(
+    net,
+    nodes: Dict[str, HoneyBadger],
+    *,
+    skip: Sequence[str] = (),
+    max_rounds: int = 50,
+    before_round: Optional[Callable[[int], None]] = None,
+    on_quiescence: Optional[Callable[[int], None]] = None,
+) -> int:
+    """THE propose-and-drain loop: each round starts an epoch on every
+    non-skipped node, drives the network to quiescence, and stops once
+    every non-skipped queue is empty (or ``max_rounds`` pass).  Returns
+    the rounds used.
+
+    This is the quiescence helper that used to be copy-pasted across
+    the Byzantine test modules; ``SimulatedCluster.run_until_drained``
+    and ``tools/fuzz.py`` both drive through it.  ``before_round``
+    (fault-timeline injection) runs before each round's proposals;
+    ``on_quiescence`` (invariant checks) runs after each round's drain
+    — both may raise to abort the run.
+    """
+    for r in range(max_rounds):
+        if before_round is not None:
+            before_round(r)
+        for nid, hb in nodes.items():
+            if nid not in skip:
+                hb.start_epoch()
+        net.run()
+        if on_quiescence is not None:
+            on_quiescence(r)
+        if all(
+            hb.pending_tx_count() == 0
+            for nid, hb in nodes.items()
+            if nid not in skip
+        ):
+            return r + 1
+    return max_rounds
 
 
 class SimulatedCluster:
@@ -53,6 +96,7 @@ class SimulatedCluster:
         shared_hub: bool = True,
         group=None,
         member_ids: Optional[Sequence[str]] = None,
+        behaviors: Optional[Dict[str, object]] = None,
     ) -> None:
         if config is not None:
             if n != 4 and n != config.n:  # both given and conflicting
@@ -96,6 +140,11 @@ class SimulatedCluster:
         from cleisthenes_tpu.protocol.honeybadger import make_tx_parse_memo
 
         tx_memo = make_tx_parse_memo() if shared_hub else None
+        behaviors = behaviors or {}
+        unknown = sorted(set(behaviors) - set(self.ids))
+        if unknown:
+            raise ValueError(f"behaviors for non-members: {unknown}")
+        self.behaviors = behaviors
         self.nodes: Dict[str, HoneyBadger] = {}
         for nid in self.ids:
             hb = HoneyBadger(
@@ -107,10 +156,16 @@ class SimulatedCluster:
                 auto_propose=auto_propose,
                 hub=hub,
                 tx_parse_memo=tx_memo,
+                behavior=behaviors.get(nid),
             )
             self.nodes[nid] = hb
             self.net.join(
                 nid, hb, HmacAuthenticator(nid, self.keys[nid].mac_keys)
+            )
+            # public route to MAC-rejection/delivery counts:
+            # Metrics.snapshot()["transport"]
+            hb.metrics.set_transport_stats(
+                lambda nid=nid: self.net.endpoint_stats(nid)
             )
         self._rr = 0  # submit() round-robin cursor
 
@@ -126,23 +181,28 @@ class SimulatedCluster:
     def pending(self) -> int:
         return sum(hb.pending_tx_count() for hb in self.nodes.values())
 
-    def run_epochs(
-        self, max_rounds: int = 50, skip: Sequence[str] = ()
+    def run_until_drained(
+        self,
+        max_rounds: int = 50,
+        skip: Sequence[str] = (),
+        before_round: Optional[Callable[[int], None]] = None,
+        on_quiescence: Optional[Callable[[int], None]] = None,
     ) -> int:
         """Propose + drain until every live queue is empty (or
-        ``max_rounds`` proposal rounds pass); returns rounds used."""
-        for r in range(max_rounds):
-            for nid, hb in self.nodes.items():
-                if nid not in skip:
-                    hb.start_epoch()
-            self.net.run()
-            if all(
-                hb.pending_tx_count() == 0
-                for nid, hb in self.nodes.items()
-                if nid not in skip
-            ):
-                return r + 1
-        return max_rounds
+        ``max_rounds`` proposal rounds pass); returns rounds used.
+        The module-level ``run_until_drained`` over this cluster's
+        network and nodes (see its docstring for the callbacks)."""
+        return run_until_drained(
+            self.net,
+            self.nodes,
+            skip=skip,
+            max_rounds=max_rounds,
+            before_round=before_round,
+            on_quiescence=on_quiescence,
+        )
+
+    # the historical name; both spellings are public API
+    run_epochs = run_until_drained
 
     def committed(self, node_id: Optional[str] = None) -> List[Batch]:
         return list(self.nodes[node_id or self.ids[0]].committed_batches)
@@ -208,4 +268,4 @@ class SimulatedCluster:
         self.net.fault_filter = f
 
 
-__all__ = ["SimulatedCluster"]
+__all__ = ["SimulatedCluster", "run_until_drained"]

@@ -377,6 +377,7 @@ class HoneyBadger:
         batch_log=None,
         hub=None,
         tx_parse_memo: Optional[_Memo] = None,
+        behavior=None,
     ) -> None:
         self.config = config
         # cluster simulations pass one shared make_tx_parse_memo()
@@ -439,8 +440,25 @@ class HoneyBadger:
             out, self.members, trace=self.trace
         )
         self._transport_managed = False
+        # semantic-adversary seam (protocol.byzantine): when a behavior
+        # is mounted, every outbound payload is offered to it once per
+        # receiver BEFORE coalescing, so a Byzantine node can lie to
+        # each peer separately while its frames still MAC and bundle
+        # exactly like honest traffic.  None (the default) adds nothing
+        # to the path.
+        self.behavior = behavior
+        outward = self._coalesce
+        if behavior is not None:
+            from cleisthenes_tpu.protocol.byzantine import (
+                BehaviorBroadcaster,
+            )
+
+            outward = BehaviorBroadcaster(
+                self._coalesce, self.members, behavior
+            )
+            behavior.attach(self)
         self.out = _CountingBroadcaster(
-            self._coalesce, self.metrics, len(self.members)
+            outward, self.metrics, len(self.members)
         )
         self._epochs: Dict[int, _EpochState] = {}
         # production: unpredictable sampling (censorship resistance);
@@ -757,6 +775,7 @@ class HoneyBadger:
                 hub=self.hub,
                 coin_issue_sink=self._queue_coin_issue,
                 trace=self.trace,
+                metrics=self.metrics,
             )
             acs.on_output = self._on_acs_output
             es = _EpochState(acs)
@@ -844,6 +863,7 @@ class HoneyBadger:
             proposer, SharePool(self.keys.tpke_pub.threshold)
         )
         if not pool.add_lazy(sender, index, d, e, z):
+            self.metrics.dedup_absorbed.inc()
             return
         self._try_decrypt(epoch, es, proposer)
         self._maybe_commit(epoch, es)
@@ -891,6 +911,8 @@ class HoneyBadger:
                     )
                 ):
                     touched.append(proposer)
+            else:
+                self.metrics.dedup_absorbed.inc()
         if not touched:
             return
         for proposer in touched:

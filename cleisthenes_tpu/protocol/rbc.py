@@ -76,6 +76,7 @@ class RBC:
         out,
         hub=None,
         trace=None,
+        metrics=None,
     ) -> None:
         self.n = config.n
         self.f = config.f
@@ -101,6 +102,9 @@ class RBC:
         self.hub.register((owner, epoch), self)
         # flight recorder (None = tracing off; utils/trace.py)
         self.trace = trace
+        # owner-node metrics (None in standalone unit tests): only the
+        # duplicate-vote absorption counter is touched here
+        self.metrics = metrics
 
         # hook set by ACS: fn(proposer_id, value_bytes)
         self.on_deliver: Optional[Callable[[str, bytes], None]] = None
@@ -319,6 +323,8 @@ class RBC:
         quorum.  Callers on the batch path must have checked
         delivered/membership (ACS.handle_echo_batch hoists both)."""
         if sender in self._echo_voted:  # one ECHO per sender
+            if self.metrics is not None:
+                self.metrics.dedup_absorbed.inc()
             return
         if not self._precheck_fields(root, branch, shard, shard_index):
             return
@@ -352,6 +358,8 @@ class RBC:
         if len(root) != 32:
             return
         if sender in self._ready_voted:  # one READY per sender
+            if self.metrics is not None:
+                self.metrics.dedup_absorbed.inc()
             return
         self._ready_voted.add(sender)
         senders = self._ready_senders.setdefault(root, set())

@@ -50,9 +50,13 @@ class VoteBank:
         member_ids: Sequence[str],
         f: int,
         inst_ids: Optional[Sequence[str]] = None,
+        metrics=None,
     ) -> None:
         self.members: List[str] = sorted(member_ids)
         self.f = f
+        # owner-node metrics (None in standalone unit tests): only the
+        # duplicate-vote absorption counter is touched here
+        self.metrics = metrics
         self.sidx: Dict[str, int] = {
             m: i for i, m in enumerate(self.members)
         }
@@ -99,6 +103,8 @@ class VoteBank:
         """Record one BVAL; returns the new count, or None if duplicate."""
         vi = 1 if value else 0
         if self.bval_seen[index, sender_idx, vi]:
+            if self.metrics is not None:
+                self.metrics.dedup_absorbed.inc()
             return None
         self.bval_seen[index, sender_idx, vi] = True
         self.bval_cnt[index, vi] += 1
@@ -107,6 +113,8 @@ class VoteBank:
     def aux_add(self, index: int, sender_idx: int, value: bool) -> bool:
         """Record one AUX; returns False on duplicate sender."""
         if self.aux_seen[index, sender_idx]:
+            if self.metrics is not None:
+                self.metrics.dedup_absorbed.inc()
             return False
         self.aux_seen[index, sender_idx] = True
         self.aux_cnt[index, 1 if value else 0] += 1
@@ -197,6 +205,10 @@ class VoteBank:
         vi = 1 if value else 0
         if is_bval:
             new = sel[~self.bval_seen[sel, si, vi]]
+            if self.metrics is not None and new.size < sel.size:
+                self.metrics.dedup_absorbed.inc(
+                    int(sel.size - new.size)
+                )
             if new.size == 0:
                 return
             self.bval_seen[new, si, vi] = True
@@ -214,6 +226,10 @@ class VoteBank:
                     bba.on_bval_bin(value)
         else:
             new = sel[~self.aux_seen[sel, si]]
+            if self.metrics is not None and new.size < sel.size:
+                self.metrics.dedup_absorbed.inc(
+                    int(sel.size - new.size)
+                )
             if new.size == 0:
                 return
             self.aux_seen[new, si] = True

@@ -151,6 +151,14 @@ class ChannelNetwork:
     def node_ids(self) -> List[str]:
         return sorted(self._endpoints)
 
+    def endpoint_stats(self, node_id: str) -> Dict[str, int]:
+        """One endpoint's frame counters, for
+        ``Metrics.snapshot()["transport"]`` (the public route to
+        ``rejected`` — adversarial tests used to reach through the
+        private ``_endpoints`` map for it)."""
+        ep = self._endpoints[node_id]
+        return {"delivered": ep.delivered, "rejected": ep.rejected}
+
     # -- fault injection ---------------------------------------------------
 
     def crash(self, node_id: str) -> None:
@@ -298,15 +306,26 @@ class ChannelNetwork:
                 )
             except ValueError:
                 ep.rejected += 1
+                self._trace_rejected(ep, sender, "undecodable")
                 continue
             if not ep.auth.verify_wire(msg, signing_prefix):
                 # the implemented version of conn.go:134-137's TODO
                 ep.rejected += 1
+                self._trace_rejected(ep, sender, "bad_mac")
                 continue
             ep.delivered += 1
             ep.handler.serve_request(msg)
             return True
         return False
+
+    @staticmethod
+    def _trace_rejected(ep: ChannelEndpoint, sender: str, why: str) -> None:
+        """One trace instant per rejected frame (when the receiving
+        handler carries a flight recorder): adversarial tampering shows
+        up in tracetool reports instead of only in a counter."""
+        tr = getattr(ep.handler, "trace", None)
+        if tr is not None:
+            tr.instant("transport", "rejected", sender=sender, why=why)
 
     def idle_phase(self) -> None:
         """The pending queue drained: give every live endpoint its idle

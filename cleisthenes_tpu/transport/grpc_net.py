@@ -174,11 +174,13 @@ class GrpcConnection:
                     msg, signing_prefix = decode_frame(wire)
                 except ValueError:
                     self.rejected += 1
+                    self._trace_rejected("undecodable")
                     continue
                 if not self._auth.verify_wire(  # conn.go:134-137, real
                     msg, signing_prefix
                 ):
                     self.rejected += 1
+                    self._trace_rejected("bad_mac")
                     continue
                 self.delivered += 1
                 handler = self._handler
@@ -189,12 +191,22 @@ class GrpcConnection:
         finally:
             self.close()
 
+    def _trace_rejected(self, why: str) -> None:
+        """Mirror of ChannelNetwork's rejected-frame instant: when the
+        bound handler (the host's SerialDispatcher) carries a flight
+        recorder, every rejected frame lands in the trace."""
+        tr = getattr(self._handler, "trace", None)
+        if tr is not None:
+            tr.instant(
+                "transport", "rejected", conn=self._conn_id, why=why
+            )
+
 
 ConnHandler = Callable[[GrpcConnection], None]  # comm.go:18
 ErrHandler = Callable[[Exception], None]  # comm.go:19
 
 
-@guarded_by("_lock", "_conns")
+@guarded_by("_lock", "_conns", "_delivered_closed", "_rejected_closed")
 class GrpcServer:
     """Reference comm.go:21-99 GrpcServer.
 
@@ -218,6 +230,10 @@ class GrpcServer:
         self._conns: List[GrpcConnection] = []
         self._lock = threading.Lock()
         self.port: Optional[int] = None
+        # counters folded in from closed connections, so stats() stays
+        # cumulative across redials
+        self._delivered_closed = 0
+        self._rejected_closed = 0
 
     def on_conn(self, handler: ConnHandler) -> None:
         """comm.go:65-70."""
@@ -232,7 +248,21 @@ class GrpcServer:
             try:
                 self._conns.remove(conn)
             except ValueError:
-                pass
+                return  # already folded into the cumulative counters
+            self._delivered_closed += conn.delivered
+            self._rejected_closed += conn.rejected
+
+    def stats(self) -> dict:
+        """Cumulative inbound frame counters across every stream this
+        server ever accepted (live + closed), for
+        ``Metrics.snapshot()["transport"]``."""
+        with self._lock:
+            delivered = self._delivered_closed
+            rejected = self._rejected_closed
+            for conn in self._conns:
+                delivered += conn.delivered
+                rejected += conn.rejected
+        return {"delivered": delivered, "rejected": rejected}
 
     def _stream_behavior(self, request_iterator, context):
         conn = GrpcConnection(

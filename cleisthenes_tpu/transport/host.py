@@ -236,6 +236,9 @@ class GrpcPayloadBroadcaster:
             self._post(member_id, msg)
 
 
+@guarded_by(
+    "_closed_stats_lock", "_closed_delivered", "_closed_rejected"
+)
 class ValidatorHost:
     """One validator process: server + peer dials + HoneyBadger node."""
 
@@ -248,6 +251,7 @@ class ValidatorHost:
         listen_addr: str = "127.0.0.1:0",
         auto_propose: bool = True,
         batch_log_path: Optional[str] = None,
+        behavior=None,
     ) -> None:
         self.config = config
         self.node_id = node_id
@@ -267,6 +271,12 @@ class ValidatorHost:
         self.server.on_conn(self._accept)
         self.pool = ConnectionPool()
         self._client = GrpcClient(self._auth)
+        # frame counters of dialed streams that have since been lost:
+        # folded in at loss time so the transport metric stays
+        # cumulative across self-healing redials
+        self._closed_stats_lock = threading.Lock()
+        self._closed_delivered = 0
+        self._closed_rejected = 0
         # per-peer UP/DEGRADED/DOWN + reconnect counters + the recent
         # backoff schedule (proof the dial layer is not spinning)
         self.health = PeerHealthTracker(
@@ -288,8 +298,13 @@ class ValidatorHost:
             out=self.out,
             auto_propose=auto_propose,
             batch_log=batch_log,
+            # semantic-adversary seam (protocol.byzantine): the same
+            # behavior objects the in-proc cluster mounts run over real
+            # gRPC — a lie per receiver, each frame validly MAC'd
+            behavior=behavior,
         )
         self.node.metrics.set_transport_health(self.health.snapshot)
+        self.node.metrics.set_transport_stats(self._transport_stats)
         # the dispatcher records queue-depth/wave events on the node's
         # own timeline (same worker thread as all protocol code)
         self.dispatcher.trace = self.node.trace
@@ -298,6 +313,23 @@ class ValidatorHost:
         self.node.on_commit = lambda epoch, batch: self._commits.put(
             (epoch, batch)
         )
+
+    def _transport_stats(self) -> Dict[str, int]:
+        """Inbound frame counters across every stream this host EVER
+        read (server-accepted + dialed, live + lost), for
+        ``Metrics.snapshot()["transport"]`` — cumulative across
+        redials, like GrpcServer.stats."""
+        stats = self.server.stats()
+        delivered = stats["delivered"]
+        rejected = stats["rejected"]
+        with self._closed_stats_lock:  # see _on_conn_lost: atomic
+            delivered += self._closed_delivered
+            rejected += self._closed_rejected
+            conns = self.pool.get_all()
+        for conn in conns:
+            delivered += getattr(conn, "delivered", 0)
+            rejected += getattr(conn, "rejected", 0)
+        return {"delivered": delivered, "rejected": rejected}
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -392,7 +424,17 @@ class ValidatorHost:
         return conn
 
     def _on_conn_lost(self, member: str, conn) -> None:
-        self.pool.remove(member)
+        # fold the dying stream's frame counters into the cumulative
+        # tally — the transport metric must stay monotonic across
+        # self-healing redials (GrpcServer.stats does the same for
+        # accepted conns).  Fold and pool-removal happen under ONE
+        # lock, and _transport_stats reads under the same lock, so a
+        # concurrent snapshot never sees the conn both folded and
+        # live (lock order everywhere: _closed_stats_lock -> pool)
+        with self._closed_stats_lock:
+            self._closed_delivered += getattr(conn, "delivered", 0)
+            self._closed_rejected += getattr(conn, "rejected", 0)
+            self.pool.remove(member)
         self.health.stream_lost(member)
         self.log.warning("peer stream lost", peer=member)
         if self._stopping.is_set():

@@ -13,8 +13,14 @@ model: f arbitrary nodes, reliable channels between correct ones):
   - replay: capture ANY node's frames and re-inject them later
     (valid MACs — the protocol's per-sender dedup must absorb them)
   - delay: hold the coalition's frames and release them much later
+  - reorder: permute nearby frames of one (sender, receiver) pair
 
 All randomness is seeded so every adversarial run replays exactly.
+
+These stages attack the WIRE: everything here is absorbed by envelope
+MACs and per-sender dedup.  The attacks the MAC layer explicitly does
+NOT cover — a key-holding node lying to each peer separately — live
+one layer up in ``protocol.byzantine`` (see docs/FAULTS.md).
 """
 
 from __future__ import annotations
@@ -31,8 +37,14 @@ class Coalition:
         self._rng = random.Random(seed)
         # stages: fn(sender, receiver, wire) -> list of frames
         self._stages: List[Callable] = []
+        # replay capture: a seeded RESERVOIR over the whole run (not
+        # the first N frames — see replay()); separate rng so capture
+        # draws never perturb the stage randomness stream
         self._captured: List[bytes] = []
         self._capture_cap = 4096
+        self._capture_seen = 0
+        self._capture_rng = random.Random(seed ^ 0x5EED0)
+        self._wants_capture = False
         # delay stage state: filter-call clock + held frames
         # (release_at, sender, receiver, frame), release bounded so a
         # pathological build-up cannot grow without bound
@@ -106,6 +118,46 @@ class Coalition:
         self._stages.append(stage)
         return self
 
+    def reorder(self, fraction: float, window: int = 4) -> "Coalition":
+        """Permute the delivery order of nearby coalition frames.
+
+        A held frame waits for the next passing frame of the SAME
+        (sender, receiver) pair, then the whole group — held frames
+        plus the current one — is released in a seeded-shuffled order
+        (pairwise envelope MACs make cross-pair reordering pointless:
+        the receiver would just reject the frame).  ``window`` caps how
+        many frames one pair can hold at once, bounding both memory and
+        how far out of order a frame can arrive.  Frames still held
+        when the pair last speaks stay held — in an asynchronous
+        network an arbitrarily-delayed frame and a lost frame are
+        indistinguishable (same caveat as ``delay``).  Seeded and
+        replay-exact like every other stage.
+        """
+        held: dict = {}  # (sender, receiver) -> [frame, ...]
+
+        def stage(sender, receiver, frames):
+            out: List[bytes] = []
+            key = (sender, receiver)
+            buf = held.get(key)
+            if buf is None:
+                buf = held[key] = []
+            for f in frames:
+                if len(buf) < window and self._rng.random() < fraction:
+                    buf.append(f)
+                    self.held_total += 1
+                elif buf:
+                    group = buf + [f]
+                    self._rng.shuffle(group)
+                    out.extend(group)
+                    self.released_total += len(buf)
+                    del buf[:]
+                else:
+                    out.append(f)
+            return out
+
+        self._stages.append(stage)
+        return self
+
     def _release_matured(self, sender: str, receiver: str) -> List[bytes]:
         """Held frames for this (sender, receiver) pair whose clock
         matured; removed from the hold queue."""
@@ -126,7 +178,14 @@ class Coalition:
 
     def replay(self, fraction: float) -> "Coalition":
         """Re-inject previously captured (any-sender) frames alongside
-        the coalition's own traffic."""
+        the coalition's own traffic.
+
+        Capture is a seeded RESERVOIR sample over every frame of the
+        run, not the first ``_capture_cap`` frames: a first-N capture
+        never sampled late-run traffic, so replay attacks could only
+        ever resend epoch-0-era frames (the capture-bias fix)."""
+
+        self._wants_capture = True
 
         def stage(sender, receiver, frames):
             out = list(frames)
@@ -137,13 +196,24 @@ class Coalition:
         self._stages.append(stage)
         return self
 
+    def _capture(self, wire: bytes) -> None:
+        """Algorithm-R reservoir: every frame of the run has equal
+        probability ``cap/seen`` of being resident when replay picks."""
+        self._capture_seen += 1
+        if len(self._captured) < self._capture_cap:
+            self._captured.append(wire)
+            return
+        j = self._capture_rng.randrange(self._capture_seen)
+        if j < self._capture_cap:
+            self._captured[j] = wire
+
     # -- the ChannelNetwork hook -------------------------------------------
 
     def filter(self, sender: str, receiver: str, wire: bytes):
         # capture everything (for replay), mutate only coalition traffic
         self._calls += 1
-        if len(self._captured) < self._capture_cap:
-            self._captured.append(wire)
+        if self._wants_capture:
+            self._capture(wire)
         if sender not in self.members:
             return wire
         frames: List[bytes] = [wire]

@@ -126,6 +126,12 @@ class Metrics:
         self.msgs_out = Counter()
         self.epochs_committed = Counter()
         self.txs_committed = Counter()
+        # duplicate protocol votes/shares dropped by one-vote-per-
+        # sender dedup (RBC echo/ready slots, VoteBank rows, share
+        # pools): the counter that makes replay/duplication attacks
+        # VISIBLE — before it, absorption happened silently across a
+        # dozen private sets
+        self.dedup_absorbed = Counter()
         self.epoch_latency = Histogram()  # seconds, propose -> commit
         self.acs_latency = Histogram()
         self.decrypt_latency = Histogram()
@@ -141,11 +147,22 @@ class Metrics:
         # .stats, set by the node when Config.trace is on): folds the
         # {events_recorded, events_dropped, high_water} block in
         self._trace_stats: Optional[Callable[[], Dict]] = None
+        # transport frame-counter provider (ChannelNetwork
+        # .endpoint_stats / ValidatorHost connection counters): folds
+        # {delivered, rejected} into snapshot()["transport"], making
+        # MAC rejections reachable without touching private transport
+        # internals
+        self._transport_stats: Optional[Callable[[], Dict]] = None
 
     def set_transport_health(
         self, provider: Optional[Callable[[], Dict]]
     ) -> None:
         self._transport_health = provider
+
+    def set_transport_stats(
+        self, provider: Optional[Callable[[], Dict]]
+    ) -> None:
+        self._transport_stats = provider
 
     def set_trace_stats(
         self, provider: Optional[Callable[[], Dict]]
@@ -200,6 +217,12 @@ class Metrics:
             "acs_p50_s": self.acs_latency.p50,
             "decrypt_p50_s": self.decrypt_latency.p50,
         }
+        transport: Dict[str, object] = {
+            "dedup_absorbed": self.dedup_absorbed.value,
+        }
+        if self._transport_stats is not None:
+            transport.update(self._transport_stats())
+        out["transport"] = transport
         if self._transport_health is not None:
             out["transport_health"] = self._transport_health()
         if self._trace_stats is not None:

@@ -1,0 +1,491 @@
+"""Deterministic schedule fuzzer for the semantic Byzantine plane.
+
+Samples composite fault schedules — semantic node behaviors
+(protocol.byzantine) x wire-level faults (utils.adversary.Coalition) x
+crash/partition/heal timelines — runs them over a seeded
+``SimulatedCluster``, and checks SAFETY INVARIANTS at every quiescence
+point:
+
+  agreement      every honest node's committed-batch prefix is
+                 byte-identical (ledger-body bytes, the exact bytes a
+                 WAL persists and CATCHUP serves)
+  no_foreign_tx  no honest node ever commits a transaction nobody
+                 submitted (sound here because the sampled adversaries
+                 never inject well-formed ciphertexts of new txs —
+                 a planted foreign tx is exactly how the self-test
+                 plants a violation)
+  liveness       every honest-submitted tx commits on every honest
+                 node within the schedule's round budget
+
+On a violation the fuzzer GREEDILY SHRINKS the schedule — dropping
+timeline events, wire stages and behaviors, then halving txs/rounds —
+re-running after each candidate edit and keeping it only if the
+violation survives.  The minimal schedule is written as a replayable
+repro file (seed + schedule JSON + violation) plus, when tracing is
+requested, a PR-3 flight-recorder artifact of the failing run.
+
+Everything is a pure function of the schedule dict: same schedule,
+same run, same verdict — which is what makes the repro files useful.
+
+Usage:
+  python -m tools.fuzz --seeds 0:20              # CI smoke sweep
+  python -m tools.fuzz --seed 7 --show           # print one schedule
+  python -m tools.fuzz --repro r.json            # replay a repro file
+  python -m tools.fuzz --seeds 0:200 --out /tmp  # deep sweep + repros
+"""
+
+from __future__ import annotations
+
+import argparse
+import copy
+import json
+import random
+import sys
+from typing import Dict, List, Optional, Sequence
+
+from cleisthenes_tpu.config import Config
+from cleisthenes_tpu.core.ledger import encode_batch_body
+from cleisthenes_tpu.protocol.byzantine import (
+    BEHAVIOR_KINDS,
+    CompositeBehavior,
+    make_behavior,
+)
+from cleisthenes_tpu.protocol.cluster import (
+    SimulatedCluster,
+    run_until_drained,
+)
+from cleisthenes_tpu.utils.adversary import Coalition
+
+SCHEDULE_VERSION = 1
+
+# wire stages the sampler may enable, with their sampled-argument
+# ranges (kept mild: the budget is f Byzantine nodes, not a dead net)
+_WIRE_STAGES = (
+    ("drop", {"fraction": (0.05, 0.4)}),
+    ("tamper", {"fraction": (0.1, 0.7)}),
+    ("duplicate", {"fraction": (0.1, 0.5)}),
+    ("replay", {"fraction": (0.1, 0.5)}),
+    ("delay", {"fraction": (0.05, 0.3)}),
+    ("reorder", {"fraction": (0.1, 0.5)}),
+)
+
+# kinds the sampler may mount: every library behavior EXCEPT the tx
+# injector — injecting txs is legal HBBFT behavior that deliberately
+# trips no_foreign_tx, so it exists only for planted-violation
+# schedules (shrinker self-tests), never sampled sweeps
+_SEMANTIC_KINDS = tuple(
+    sorted(k for k in BEHAVIOR_KINDS if k != "tx_injector")
+)
+
+
+class Violation(Exception):
+    """A safety/liveness invariant failed; carries the report dict."""
+
+    def __init__(self, invariant: str, detail: str, rnd: int) -> None:
+        super().__init__(f"{invariant}: {detail} (round {rnd})")
+        self.report = {
+            "invariant": invariant,
+            "detail": detail,
+            "round": rnd,
+        }
+
+
+# ---------------------------------------------------------------------------
+# schedule sampling
+# ---------------------------------------------------------------------------
+
+
+def sample_schedule(seed: int, n: int = 4, rounds: int = 12) -> dict:
+    """One composite fault schedule, a pure function of ``seed``.
+
+    All faults — semantic behaviors, wire stages, crash/partition
+    timeline — are confined to ONE f-sized coalition, so the honest
+    majority keeps its HBBFT guarantees and the liveness invariant is
+    legitimately enforceable."""
+    rng = random.Random(seed)
+    f = (n - 1) // 3
+    ids = [f"node{i:03d}" for i in range(n)]
+    bad = sorted(rng.sample(ids, f)) if f else []
+
+    behaviors: List[dict] = []
+    for node in bad:
+        for kind in rng.sample(_SEMANTIC_KINDS, rng.randrange(0, 3)):
+            behaviors.append(
+                {"kind": kind, "node": node, "seed": rng.randrange(1 << 16)}
+            )
+
+    wire: List[dict] = []
+    for stage, argspec in _WIRE_STAGES:
+        if rng.random() < 0.35:
+            args = {
+                name: round(rng.uniform(lo, hi), 3)
+                for name, (lo, hi) in argspec.items()
+            }
+            wire.append({"stage": stage, "args": args})
+
+    timeline: List[dict] = []
+    if bad and rng.random() < 0.5:
+        victim = rng.choice(bad)
+        at = rng.randrange(1, max(2, rounds // 2))
+        timeline.append({"round": at, "op": "crash", "node": victim})
+        if rng.random() < 0.6:
+            timeline.append(
+                {
+                    "round": rng.randrange(at + 1, at + 4),
+                    "op": "recover",
+                    "node": victim,
+                }
+            )
+    if bad and rng.random() < 0.4:
+        b = rng.choice(bad)
+        peer = rng.choice([i for i in ids if i != b])
+        at = rng.randrange(0, max(1, rounds // 2))
+        timeline.append(
+            {"round": at, "op": "partition", "node": b, "peer": peer}
+        )
+        timeline.append(
+            {
+                "round": rng.randrange(at + 1, at + 4),
+                "op": "heal",
+                "node": b,
+                "peer": peer,
+            }
+        )
+    timeline.sort(key=lambda ev: (ev["round"], ev["op"], ev["node"]))
+
+    return {
+        "version": SCHEDULE_VERSION,
+        "seed": seed,
+        "n": n,
+        "f": f,
+        "batch_size": 8,
+        "key_seed": 33,
+        "rounds": rounds,
+        "txs": 3 * n,
+        "bad": bad,
+        "behaviors": behaviors,
+        "wire": wire,
+        "timeline": timeline,
+        "check_liveness": True,
+    }
+
+
+# ---------------------------------------------------------------------------
+# schedule execution
+# ---------------------------------------------------------------------------
+
+
+def _build_cluster(schedule: dict, trace: bool) -> SimulatedCluster:
+    by_node: Dict[str, list] = {}
+    for spec in schedule["behaviors"]:
+        b = make_behavior(
+            spec["kind"], seed=spec.get("seed", 0), **spec.get("args", {})
+        )
+        by_node.setdefault(spec["node"], []).append(b)
+    behaviors = {
+        nid: (bs[0] if len(bs) == 1 else CompositeBehavior(bs))
+        for nid, bs in by_node.items()
+    }
+    cfg = Config(
+        n=schedule["n"],
+        batch_size=schedule["batch_size"],
+        seed=schedule["seed"],
+        trace=trace,
+    )
+    cluster = SimulatedCluster(
+        n=schedule["n"],
+        config=cfg,
+        seed=schedule["seed"],
+        key_seed=schedule["key_seed"],
+        behaviors=behaviors,
+    )
+    if schedule["wire"]:
+        coal = Coalition(schedule["bad"], seed=schedule["seed"])
+        for spec in schedule["wire"]:
+            getattr(coal, spec["stage"])(**spec["args"])
+        cluster.fault_filter = coal.filter
+    return cluster
+
+
+def _apply_event(net, ev: dict) -> None:
+    op = ev["op"]
+    if op == "crash":
+        net.crash(ev["node"])
+    elif op == "recover":
+        net.recover(ev["node"])
+    elif op == "partition":
+        net.partition(ev["node"], ev["peer"])
+    elif op == "heal":
+        net.heal(ev["node"], ev["peer"])
+    else:
+        raise ValueError(f"unknown timeline op {op!r}")
+
+
+def _check_safety(cluster, honest: List[str], submitted: set, rnd: int):
+    """Raise Violation on any safety breach at this quiescence point."""
+    nodes = cluster.nodes
+    depth = min(len(nodes[h].committed_batches) for h in honest)
+    for e in range(depth):
+        bodies = {
+            encode_batch_body(e, nodes[h].committed_batches[e])
+            for h in honest
+        }
+        if len(bodies) != 1:
+            raise Violation(
+                "agreement",
+                f"honest ledgers fork at epoch {e}",
+                rnd,
+            )
+    for h in honest:
+        for e, batch in enumerate(nodes[h].committed_batches):
+            for tx in batch.tx_list():
+                if tx not in submitted:
+                    raise Violation(
+                        "no_foreign_tx",
+                        f"{h} committed unsubmitted tx {tx!r} "
+                        f"in epoch {e}",
+                        rnd,
+                    )
+
+
+def run_schedule(
+    schedule: dict, trace_path: Optional[str] = None
+) -> Optional[dict]:
+    """Execute one schedule; returns the violation report dict, or
+    None if every invariant held.  With ``trace_path`` the run records
+    a flight-recorder artifact (written whether or not it fails)."""
+    cluster = _build_cluster(schedule, trace=trace_path is not None)
+    bad = set(schedule["bad"])
+    honest = [nid for nid in cluster.ids if nid not in bad]
+    submitted: set = set()
+    for i in range(schedule["txs"]):
+        tx = b"fuzz-%06d" % i
+        cluster.nodes[honest[i % len(honest)]].add_transaction(tx)
+        submitted.add(tx)
+
+    by_round: Dict[int, List[dict]] = {}
+    for ev in schedule["timeline"]:
+        by_round.setdefault(ev["round"], []).append(ev)
+
+    def before_round(r: int) -> None:
+        for ev in by_round.get(r, ()):
+            _apply_event(cluster.net, ev)
+
+    def on_quiescence(r: int) -> None:
+        _check_safety(cluster, honest, submitted, r)
+
+    violation: Optional[dict] = None
+    rounds_used = schedule["rounds"]
+    try:
+        rounds_used = run_until_drained(
+            cluster.net,
+            cluster.nodes,
+            skip=bad,
+            max_rounds=schedule["rounds"],
+            before_round=before_round,
+            on_quiescence=on_quiescence,
+        )
+    except Violation as v:
+        violation = v.report
+    if violation is None and schedule.get("check_liveness", True):
+        for h in honest:
+            committed = {
+                tx
+                for b in cluster.nodes[h].committed_batches
+                for tx in b.tx_list()
+            }
+            missing = submitted - committed
+            if missing or cluster.nodes[h].pending_tx_count():
+                violation = {
+                    "invariant": "liveness",
+                    "detail": (
+                        f"{h} missing {len(missing)} submitted txs "
+                        f"after {rounds_used} rounds"
+                    ),
+                    "round": rounds_used,
+                }
+                break
+    if trace_path is not None:
+        cluster.write_trace(trace_path)
+    return violation
+
+
+# ---------------------------------------------------------------------------
+# shrinking + repro files
+# ---------------------------------------------------------------------------
+
+
+def shrink(schedule: dict, violation: Optional[dict] = None):
+    """Greedily minimize a failing schedule: drop timeline events,
+    wire stages and behaviors one at a time (keeping any removal that
+    still fails), then halve txs and rounds.  Returns
+    ``(minimal_schedule, violation)``.
+
+    A candidate is kept only if it violates the SAME invariant as the
+    original failure — otherwise e.g. halving the round budget under a
+    mounted delay fault could manufacture an unrelated 'liveness'
+    artifact and the shrinker would happily minimize that instead of
+    the real bug.  Deterministic — the candidate order is fixed — and
+    terminates because every accepted edit strictly shrinks the
+    schedule.  Pass the already-observed ``violation`` to skip the
+    redundant confirming run."""
+    base_v = violation if violation is not None else run_schedule(schedule)
+    if base_v is None:
+        raise ValueError("shrink() needs a failing schedule")
+    want = base_v["invariant"]
+
+    def still_fails(cand: dict) -> Optional[dict]:
+        v = run_schedule(cand)
+        return v if v is not None and v["invariant"] == want else None
+
+    cur = copy.deepcopy(schedule)
+    cur_v = base_v
+    changed = True
+    while changed:
+        changed = False
+        for key in ("timeline", "wire", "behaviors"):
+            i = 0
+            while i < len(cur[key]):
+                cand = copy.deepcopy(cur)
+                del cand[key][i]
+                v = still_fails(cand)
+                if v is not None:
+                    cur, cur_v = cand, v
+                    changed = True
+                else:
+                    i += 1
+        for field, floor in (("txs", 1), ("rounds", 2)):
+            while cur[field] > floor:
+                cand = copy.deepcopy(cur)
+                cand[field] = max(floor, cur[field] // 2)
+                v = still_fails(cand)
+                if v is None:
+                    break
+                cur, cur_v = cand, v
+                changed = True
+    return cur, cur_v
+
+
+def write_repro(
+    path: str, schedule: dict, violation: dict
+) -> None:
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(
+            {"schedule": schedule, "violation": violation},
+            fh,
+            indent=2,
+            sort_keys=True,
+        )
+        fh.write("\n")
+
+
+def load_repro(path: str) -> dict:
+    with open(path, "r", encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def _parse_seeds(spec: str) -> List[int]:
+    """"0:20" -> [0..19]; "3,7,11" -> [3, 7, 11]; "5" -> [5]."""
+    if ":" in spec:
+        lo, hi = spec.split(":", 1)
+        return list(range(int(lo), int(hi)))
+    return [int(s) for s in spec.replace(",", " ").split()]
+
+
+def fuzz_seeds(
+    seeds: Sequence[int],
+    n: int = 4,
+    rounds: int = 12,
+    out_dir: Optional[str] = None,
+    trace: bool = True,
+) -> int:
+    """Run a schedule per seed; on the first violation, shrink it and
+    emit a repro file plus (by default) a flight-recorder trace
+    artifact of the minimal failing run.  Returns a process exit code
+    (0 = every invariant held on every seed)."""
+    import pathlib
+
+    for seed in seeds:
+        schedule = sample_schedule(seed, n=n, rounds=rounds)
+        violation = run_schedule(schedule)
+        if violation is None:
+            print(f"seed {seed:6d}: ok")
+            continue
+        print(f"seed {seed:6d}: VIOLATION {violation['invariant']}")
+        minimal, final = shrink(schedule, violation)
+        out = pathlib.Path(out_dir or ".")
+        out.mkdir(parents=True, exist_ok=True)
+        repro_path = out / f"fuzz_repro_seed{seed}.json"
+        write_repro(str(repro_path), minimal, final)
+        print(f"  minimal repro -> {repro_path}")
+        if trace:
+            trace_path = out / f"fuzz_repro_seed{seed}.trace.json"
+            run_schedule(minimal, trace_path=str(trace_path))
+            print(f"  flight-recorder artifact -> {trace_path}")
+        return 1
+    return 0
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tools.fuzz", description=__doc__.splitlines()[0]
+    )
+    ap.add_argument("--seeds", help="seed range lo:hi or list a,b,c")
+    ap.add_argument("--seed", type=int, help="single seed")
+    ap.add_argument("--n", type=int, default=4, help="cluster size")
+    ap.add_argument("--rounds", type=int, default=12)
+    ap.add_argument(
+        "--show", action="store_true", help="print the schedule, no run"
+    )
+    ap.add_argument("--repro", help="replay a repro file")
+    ap.add_argument("--out", help="directory for repro artifacts")
+    ap.add_argument(
+        "--no-trace",
+        action="store_true",
+        help="skip the flight-recorder artifact for failing runs",
+    )
+    args = ap.parse_args(argv)
+
+    if args.repro:
+        rep = load_repro(args.repro)
+        violation = run_schedule(rep["schedule"])
+        want = rep.get("violation")
+        print(f"replayed: {violation}")
+        if violation is None:
+            print("repro no longer triggers a violation")
+            return 1
+        if want and violation["invariant"] != want["invariant"]:
+            print(f"violation changed (recorded: {want})")
+            return 1
+        return 0
+
+    if args.seed is not None:
+        seeds: List[int] = [args.seed]
+    elif args.seeds:
+        seeds = _parse_seeds(args.seeds)
+    else:
+        ap.error("need --seed, --seeds or --repro")
+        return 2
+
+    if args.show:  # print the sampled schedule(s), run nothing
+        for seed in seeds:
+            schedule = sample_schedule(seed, n=args.n, rounds=args.rounds)
+            json.dump(schedule, sys.stdout, indent=2, sort_keys=True)
+            print()
+        return 0
+    return fuzz_seeds(
+        seeds,
+        n=args.n,
+        rounds=args.rounds,
+        out_dir=args.out,
+        trace=not args.no_trace,
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())
