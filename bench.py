@@ -50,6 +50,18 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from tools import benchlock  # noqa: E402
 
 
+def _append_trend(result: dict) -> None:
+    """Fold the finished artifact into the perf-regression trend
+    (BENCH_TREND.jsonl; tools/perfgate.py gates CI against it).
+    Best-effort: trend bookkeeping must never sink a measurement."""
+    try:
+        from tools import perfgate
+
+        perfgate.append_bench_trend(result)
+    except Exception as exc:  # noqa: BLE001 — recorded, not raised
+        print(f"[bench] trend append failed: {exc!r}", file=sys.stderr)
+
+
 def _load_snapshot() -> dict:
     try:
         return benchlock.load_snapshot()
@@ -1002,6 +1014,7 @@ def _run_locked() -> None:
     if healthy:
         result, detail = _spawn_child(force_cpu=False)
         if result is not None:
+            _append_trend(result)
             print(json.dumps(result))
             return
         errors.append(f"tpu run: {detail}")
@@ -1011,6 +1024,7 @@ def _run_locked() -> None:
             "axon TPU relay unavailable; XLA path measured on host CPU "
             f"({'; '.join(errors)})"
         )
+        _append_trend(result)
         print(json.dumps(result))
         return
     errors.append(f"cpu fallback: {detail}")
@@ -1067,16 +1081,18 @@ def run_trace() -> None:
             )
         )
         return
-    print(
-        json.dumps(
-            {
-                "metric": "trace_protocol_n16",
-                "n": pc["n"],
-                "batch": pc["batch"],
-                **result,
-            }
-        )
-    )
+    doc = {
+        "metric": "trace_protocol_n16",
+        "n": pc["n"],
+        "batch": pc["batch"],
+        **result,
+    }
+    # the traced run carries the richest trend record of all: p50 AND
+    # stage shares AND the deterministic dispatch count
+    _append_trend({"platform": "cpu", "trace_protocol_n16": {
+        "n": pc["n"], "batch": pc["batch"], "cpu": result,
+    }})
+    print(json.dumps(doc))
 
 
 if __name__ == "__main__":

@@ -21,25 +21,30 @@
 #      tools/tracetool.py --validate gates its schema + per-node
 #      monotone sequence numbers, so the tracing plane cannot rot
 #      silently between perf rounds (docs/TRACING.md)
-#   4. fast test tier      — pytest minus the multi-minute scale
+#   4. perf-regression gate — tools/perfgate.py runs a seeded traced
+#      mini-bench (4 nodes, 3 epochs) and compares epoch p50, the
+#      DETERMINISTIC hub-dispatch count, and per-stage wall shares
+#      against the trailing BENCH_TREND.jsonl records with noise
+#      bands; the first run seeds the trend file (always passes)
+#   5. fast test tier      — pytest minus the multi-minute scale
 #      tests, under tools/covgate.py (PEP 669 line coverage; the
 #      tier must execute >= 85% of the package's executable lines —
 #      the travis pipeline's coverage upload, translated to a GATE)
-#   5. race-analog tier    — the seeded deterministic-scheduler suites
+#   6. race-analog tier    — the seeded deterministic-scheduler suites
 #      (transport/byzantine), this stack's answer to `-race`
 #      (SURVEY.md §5.2: replayable interleavings instead of a dynamic
 #      race detector), plus the real-thread gRPC suite
-#   6. fault tier          — the crash/partition/adversary suite
+#   7. fault tier          — the crash/partition/adversary suite
 #      (`-m faults`: Byzantine coalitions, crash+WAL-restart+CATCHUP,
 #      gRPC backoff redial) replayed over a fixed 3-seed matrix, so a
 #      fault-handling regression on ANY matrix seed gates the merge
-#   7. fuzz smoke          — tools/fuzz.py over a fixed seed band:
+#   8. fuzz smoke          — tools/fuzz.py over a fixed seed band:
 #      composite semantic (protocol/byzantine) + wire (Coalition) +
 #      crash/partition schedules on seeded 4-node clusters, safety
 #      invariants checked at every quiescence point; a violation
 #      shrinks to a minimal replayable repro.  The deep band (200
 #      seeds) rides the slow tier (tests/test_fuzz.py)
-#   8. full tier           — everything, including the N=64 slow test
+#   9. full tier           — everything, including the N=64 slow test
 #      (skipped when CI_FAST=1)
 #
 # Usage:  ./ci.sh          # full gate
@@ -48,29 +53,35 @@
 set -euo pipefail
 cd "$(dirname "$0")"
 
-echo "== [1/8] syntax + format gate"
+echo "== [1/9] syntax + format gate"
 python -m compileall -q cleisthenes_tpu tests bench.py __graft_entry__.py
 python tools/format_gate.py
 
-echo "== [2/8] staticcheck gate: determinism plane + lock discipline"
+echo "== [2/9] staticcheck gate: determinism plane + lock discipline"
 python -m tools.staticcheck cleisthenes_tpu
 
-echo "== [3/8] observability gate: traced seeded cluster -> tracetool --validate"
+echo "== [3/9] observability gate: traced seeded cluster -> tracetool --validate"
 TRACE_ARTIFACT="$(mktemp /tmp/cleisthenes_trace_ci.XXXXXX.json)"
 trap 'rm -f "$TRACE_ARTIFACT"' EXIT
 JAX_PLATFORMS=cpu python -m tools.tracetool \
     --capture "$TRACE_ARTIFACT" --n 4 --seed 7 --txs 24
 python -m tools.tracetool "$TRACE_ARTIFACT" --validate
 
-echo "== [4/8] fast tests (with coverage gate)"
+echo "== [4/9] perf-regression gate: seeded mini-bench vs BENCH_TREND.jsonl"
+# seeded traced mini-bench through tools/perfgate.py; seeds the trend
+# on the first run, gates epoch-p50 / dispatch-count / stage-share
+# regressions (noise-banded) on every later run and appends on pass
+JAX_PLATFORMS=cpu python -m tools.perfgate --trend BENCH_TREND.jsonl
+
+echo "== [5/9] fast tests (with coverage gate)"
 COVGATE_MIN="${COVGATE_MIN:-85}" \
     python -m pytest tests/ -q -m "not slow" -x -p tools.covgate
 
-echo "== [5/8] race-analog: seeded-scheduler + threaded-transport suites"
+echo "== [6/9] race-analog: seeded-scheduler + threaded-transport suites"
 python -m pytest tests/test_transport.py tests/test_byzantine.py \
     tests/test_semantic_byzantine.py tests/test_grpc.py -q -x -m "not slow"
 
-echo "== [6/8] fault gate: crash/partition/adversary suite, 3-seed matrix"
+echo "== [7/9] fault gate: crash/partition/adversary suite, 3-seed matrix"
 # the full faults-marked suite already ran at the default seed in
 # stages 4-5; the matrix replays the FAULT_SEED-parametrized
 # crash+WAL-restart+CATCHUP scenario (the seed-sensitive entry point)
@@ -81,7 +92,7 @@ for seed in 11 23 47; do
         -m faults -k crash_restart_wal_catchup
 done
 
-echo "== [7/8] fuzz smoke: semantic+wire schedule fuzzer, 20-seed band"
+echo "== [8/9] fuzz smoke: semantic+wire schedule fuzzer, 20-seed band"
 # 4-node seeded clusters, composite behavior/wire/crash schedules;
 # any invariant violation exits non-zero, leaving the shrunken repro
 # + trace artifact in FUZZ_OUT (cleaned only on success)
@@ -90,9 +101,9 @@ JAX_PLATFORMS=cpu python -m tools.fuzz --seeds 0:20 --out "$FUZZ_OUT"
 rm -rf "$FUZZ_OUT"
 
 if [[ "${CI_FAST:-0}" == "1" ]]; then
-    echo "== [8/8] skipped (CI_FAST=1)"
+    echo "== [9/9] skipped (CI_FAST=1)"
 else
-    echo "== [8/8] full suite incl. scale tests"
+    echo "== [9/9] full suite incl. scale tests"
     python -m pytest tests/ -q -m slow
 fi
 

@@ -75,6 +75,23 @@ class Config:
         sites hold None and the hot path pays one identity check.
       trace_buffer: per-node trace ring capacity (newest events win;
         overflow counts as drops in Metrics.snapshot()["trace"]).
+      obs_port: opt-in live telemetry endpoints (transport/obs_http.py):
+        None (default) serves nothing; 0 binds an ephemeral localhost
+        port (tests/demo); N binds 127.0.0.1:N.  Serves /metrics
+        (Prometheus text exposition), /healthz (UP/DEGRADED/DOWN from
+        peer health + SLO watchdogs) and /vars (full JSON snapshot +
+        sampled time series) on ValidatorHost and SimulatedCluster.
+      obs_sample_period_s: telemetry sampling cadence for the bounded
+        time-series rings (utils/timeseries.py) when the obs plane is
+        on; each tick also runs the SLO watchdog checks.
+      slo_stall_factor / slo_stall_grace_s: the epoch-stall watchdog's
+        commit budget is max(grace, factor * recent epoch p50) — no
+        commit within it while txs are pending flips health to DOWN
+        (utils/watchdog.py).
+      slo_queue_depth: pending-transaction depth above which the
+        backpressure alarm fires (ingress outrunning commit).
+      slo_peer_lag_epochs: epoch-frontier gap above which a trailing
+        peer counts as lagging (peer-lag detector; in-proc clusters).
     """
 
     n: int = 4
@@ -92,6 +109,12 @@ class Config:
     mesh_shape: Optional[tuple] = None
     trace: bool = False
     trace_buffer: int = 1 << 16
+    obs_port: Optional[int] = None
+    obs_sample_period_s: float = 1.0
+    slo_stall_factor: float = 8.0
+    slo_stall_grace_s: float = 10.0
+    slo_queue_depth: int = 100_000
+    slo_peer_lag_epochs: int = 8
     # Epoch pipelining (BASELINE config 5): propose into epoch e+1 the
     # moment epoch e's ACS outputs, so e+1's RS-encode/Merkle-forest
     # and VAL/ECHO exchange overlap e's decryption-share phase.
@@ -127,6 +150,26 @@ class Config:
         if self.trace_buffer <= 0:
             raise ValueError(
                 f"trace_buffer={self.trace_buffer} must be > 0"
+            )
+        if self.obs_port is not None and not (0 <= self.obs_port <= 65535):
+            raise ValueError(
+                f"obs_port={self.obs_port} must be None or 0..65535"
+            )
+        if self.obs_sample_period_s <= 0:
+            raise ValueError(
+                f"obs_sample_period_s={self.obs_sample_period_s} "
+                "must be > 0"
+            )
+        if self.slo_stall_factor <= 0 or self.slo_stall_grace_s <= 0:
+            raise ValueError(
+                f"stall SLO needs factor>0 grace>0, got "
+                f"{self.slo_stall_factor}/{self.slo_stall_grace_s}"
+            )
+        if self.slo_queue_depth <= 0 or self.slo_peer_lag_epochs <= 0:
+            raise ValueError(
+                f"SLO thresholds must be > 0: queue_depth="
+                f"{self.slo_queue_depth} peer_lag="
+                f"{self.slo_peer_lag_epochs}"
             )
         if self.mesh_shape is not None:
             from cleisthenes_tpu.parallel.mesh import validate_mesh_shape

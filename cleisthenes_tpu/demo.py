@@ -63,7 +63,24 @@ def main(argv=None) -> int:
         "write the merged Chrome-trace artifact here on exit — open "
         "it at ui.perfetto.dev (grpc mode only; see docs/TRACING.md)",
     )
+    ap.add_argument(
+        "--obs-port",
+        type=int,
+        default=None,
+        metavar="BASE",
+        help="serve live telemetry (/metrics /healthz /vars, "
+        "transport/obs_http.py) on 127.0.0.1: node i listens on "
+        "BASE+i; 0 picks ephemeral ports (printed at boot; grpc "
+        "mode only — see docs/OBSERVABILITY.md)",
+    )
     args = ap.parse_args(argv)
+    if args.obs_port is not None and (
+        args.obs_port < 0 or args.obs_port + args.n - 1 > 65535
+    ):
+        ap.error(
+            f"--obs-port {args.obs_port}: need 0 (ephemeral) or a base "
+            f"with BASE+{args.n - 1} <= 65535 (one port per node)"
+        )
     configure_logging(logging.DEBUG if args.verbose else logging.INFO)
 
     cfg = Config(
@@ -87,15 +104,31 @@ def main(argv=None) -> int:
                 "path; lockstep mode has no per-node timelines "
                 "(flag ignored)"
             )
+        if args.obs_port is not None:
+            print(
+                "== note: --obs-port serves per-validator telemetry; "
+                "lockstep mode has no per-node metrics (flag ignored)"
+            )
         return _lockstep_main(args, cfg)
     keys = setup_keys(cfg, ids)
     if args.dkg:
         keys = _dkg_rekey(cfg, ids, keys)
     if args.log_dir:
         os.makedirs(args.log_dir, exist_ok=True)
+
+    def node_cfg(rank: int) -> Config:
+        """Per-node config: telemetry ports fan out from the base
+        (--obs-port 9100 -> node i scrapes at 9100+i; 0 = ephemeral)."""
+        if args.obs_port is None:
+            return cfg
+        import dataclasses
+
+        port = args.obs_port + rank if args.obs_port > 0 else 0
+        return dataclasses.replace(cfg, obs_port=port)
+
     hosts = {
         i: ValidatorHost(
-            cfg,
+            node_cfg(rank),
             i,
             ids,
             keys[i],
@@ -105,10 +138,15 @@ def main(argv=None) -> int:
                 else None
             ),
         )
-        for i in ids
+        for rank, i in enumerate(ids)
     }
     addrs = {i: h.listen() for i, h in hosts.items()}
     print(f"== listening: {addrs}")
+    if args.obs_port is not None:
+        obs_addrs = {
+            i: f"127.0.0.1:{h.obs.port}" for i, h in hosts.items()
+        }
+        print(f"== telemetry (/metrics /healthz /vars): {obs_addrs}")
     threads = [
         threading.Thread(target=h.connect, args=(addrs,))
         for h in hosts.values()

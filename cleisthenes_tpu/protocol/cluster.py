@@ -168,6 +168,60 @@ class SimulatedCluster:
                 lambda nid=nid: self.net.endpoint_stats(nid)
             )
         self._rr = 0  # submit() round-robin cursor
+        # SLO watchdog plane (utils/watchdog.py): one per node, peer
+        # state from the channel network's fault view (crash/partition)
+        # and peer LAG from the epoch frontiers the in-proc cluster can
+        # see directly.  Alert counters fold into each node's
+        # Metrics.snapshot()["alerts"]; cluster.health() is the
+        # worst-of verdict.
+        from cleisthenes_tpu.utils.watchdog import SloWatchdog
+
+        self.watchdogs: Dict[str, SloWatchdog] = {}
+        for nid in self.ids:
+            wd = SloWatchdog(
+                metrics=self.nodes[nid].metrics,
+                pending_fn=self.nodes[nid].pending_tx_count,
+                stall_factor=self.config.slo_stall_factor,
+                stall_grace_s=self.config.slo_stall_grace_s,
+                queue_depth_limit=self.config.slo_queue_depth,
+                peer_lag_epochs=self.config.slo_peer_lag_epochs,
+                peer_states_fn=lambda nid=nid: self.net.link_states(nid),
+                peer_lag_fn=lambda nid=nid: self._peer_lag(nid),
+                trace=self.nodes[nid].trace,
+            )
+            self.nodes[nid].metrics.set_alerts(wd.alerts_block)
+            self.watchdogs[nid] = wd
+        # live telemetry endpoints (Config.obs_port): ONE server fronts
+        # the whole roster, each sample labeled node="..." — started
+        # eagerly (there is no listen() phase on the in-proc cluster).
+        # Each node gets a bounded-ring sampler (utils/timeseries.py);
+        # the sampler threads only READ thread-safe metrics, so the
+        # deterministic scheduler is unaffected.
+        self.obs = None
+        self.samplers: Dict[str, object] = {}
+        if self.config.obs_port is not None:
+            from cleisthenes_tpu.transport.obs_http import (
+                ObsServer,
+                ObsTarget,
+            )
+            from cleisthenes_tpu.utils.timeseries import TimeSeriesSampler
+
+            targets = []
+            for nid in self.ids:
+                sampler = TimeSeriesSampler(self.nodes[nid].metrics.snapshot)
+                sampler.on_tick(self.watchdogs[nid].check)
+                sampler.start(self.config.obs_sample_period_s)
+                self.samplers[nid] = sampler
+                targets.append(
+                    ObsTarget(
+                        nid,
+                        self.nodes[nid].metrics,
+                        self.watchdogs[nid],
+                        sampler,
+                    )
+                )
+            self.obs = ObsServer(targets, port=self.config.obs_port)
+            self.obs.start()
 
     # -- application surface ----------------------------------------------
 
@@ -222,6 +276,40 @@ class SimulatedCluster:
             }
             assert len(lists) == 1, f"fork at epoch {e}"
         return depth
+
+    # -- observability (telemetry + SLO surface) ---------------------------
+
+    def _peer_lag(self, node_id: str) -> Dict[str, int]:
+        """``node_id``'s view of peers trailing its epoch frontier
+        (positive gaps only) — the in-proc peer-lag signal: a crashed
+        or starved node stops advancing and shows up here on every
+        healthy node's watchdog."""
+        own = self.nodes[node_id].epoch
+        return {
+            nid: own - hb.epoch
+            for nid, hb in self.nodes.items()
+            if nid != node_id and own - hb.epoch > 0
+        }
+
+    def health(self) -> Dict[str, object]:
+        """Run every node's SLO watchdog checks and return the
+        /healthz-shaped verdict: ``{"status": worst, "nodes": {...}}``
+        (the convenience accessor tests assert against — no HTTP
+        round-trip needed)."""
+        from cleisthenes_tpu.utils.watchdog import worst_health
+
+        nodes = {
+            nid: self.watchdogs[nid].check() for nid in self.ids
+        }
+        return {"status": worst_health(nodes.values()), "nodes": nodes}
+
+    def stop(self) -> None:
+        """Tear down background observers (the in-proc cluster itself
+        has no threads; only the opt-in obs plane does)."""
+        for sampler in self.samplers.values():
+            sampler.stop()
+        if self.obs is not None:
+            self.obs.stop()
 
     # -- observability (the flight-recorder surface) -----------------------
 

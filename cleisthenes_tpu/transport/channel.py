@@ -159,6 +159,25 @@ class ChannelNetwork:
         ep = self._endpoints[node_id]
         return {"delivered": ep.delivered, "rejected": ep.rejected}
 
+    def link_states(self, node_id: str) -> Dict[str, str]:
+        """``node_id``'s view of every peer link: "down" when the peer
+        crashed or a partition severs the pair, else "up" — the
+        channel-transport analog of the gRPC dial layer's
+        PeerHealthTracker, feeding the SLO watchdog's peer detector
+        (the public route to fault state; /healthz must degrade under
+        an injected partition on THIS transport too)."""
+        return {
+            peer: (
+                "down"
+                if peer in self._crashed
+                or node_id in self._crashed
+                or (node_id, peer) in self._partitions
+                else "up"
+            )
+            for peer in sorted(self._endpoints)
+            if peer != node_id
+        }
+
     # -- fault injection ---------------------------------------------------
 
     def crash(self, node_id: str) -> None:

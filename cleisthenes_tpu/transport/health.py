@@ -32,9 +32,10 @@ from typing import Dict, List, Optional
 
 from cleisthenes_tpu.utils.determinism import guarded_by
 
-UP = "up"
-DEGRADED = "degraded"
-DOWN = "down"
+# canonical UP/DEGRADED/DOWN vocabulary lives in utils/watchdog.py;
+# dial health and SLO verdicts must stay comparable (host peer states
+# feed SloWatchdog._lagging_peers and the /healthz fold)
+from cleisthenes_tpu.utils.watchdog import DEGRADED, DOWN, UP
 
 # consecutive failed dials before a DEGRADED peer is declared DOWN
 # (it keeps being redialed — DOWN is a reporting state, not a stop)

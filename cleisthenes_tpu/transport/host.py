@@ -305,6 +305,48 @@ class ValidatorHost:
         )
         self.node.metrics.set_transport_health(self.health.snapshot)
         self.node.metrics.set_transport_stats(self._transport_stats)
+        # SLO watchdogs (utils/watchdog.py) run on every host: alert
+        # counters fold into Metrics.snapshot()["alerts"] whether or
+        # not the scrape endpoints are enabled.  Peer states come from
+        # the dial layer's health tracker.
+        from cleisthenes_tpu.utils.watchdog import SloWatchdog
+
+        self.watchdog = SloWatchdog(
+            metrics=self.node.metrics,
+            pending_fn=self.node.pending_tx_count,
+            stall_factor=config.slo_stall_factor,
+            stall_grace_s=config.slo_stall_grace_s,
+            queue_depth_limit=config.slo_queue_depth,
+            peer_lag_epochs=config.slo_peer_lag_epochs,
+            peer_states_fn=self._peer_states,
+            trace=self.node.trace,
+        )
+        self.node.metrics.set_alerts(self.watchdog.alerts_block)
+        # live telemetry endpoints (Config.obs_port): bounded-ring
+        # sampler + localhost /metrics | /healthz | /vars.  Built here,
+        # started by listen() next to the gRPC server.
+        self.sampler = None
+        self.obs = None
+        if config.obs_port is not None:
+            from cleisthenes_tpu.transport.obs_http import (
+                ObsServer,
+                ObsTarget,
+            )
+            from cleisthenes_tpu.utils.timeseries import TimeSeriesSampler
+
+            self.sampler = TimeSeriesSampler(self.node.metrics.snapshot)
+            self.sampler.on_tick(self.watchdog.check)
+            self.obs = ObsServer(
+                [
+                    ObsTarget(
+                        node_id,
+                        self.node.metrics,
+                        self.watchdog,
+                        self.sampler,
+                    )
+                ],
+                port=config.obs_port,
+            )
         # the dispatcher records queue-depth/wave events on the node's
         # own timeline (same worker thread as all protocol code)
         self.dispatcher.trace = self.node.trace
@@ -313,6 +355,14 @@ class ValidatorHost:
         self.node.on_commit = lambda epoch, batch: self._commits.put(
             (epoch, batch)
         )
+
+    def _peer_states(self) -> Dict[str, str]:
+        """Peer UP/DEGRADED/DOWN states for the SLO watchdog's peer
+        detector (the dial layer's health snapshot, states only)."""
+        return {
+            peer: str(ph["state"])
+            for peer, ph in self.health.snapshot().items()
+        }
 
     def _transport_stats(self) -> Dict[str, int]:
         """Inbound frame counters across every stream this host EVER
@@ -343,6 +393,10 @@ class ValidatorHost:
         self.server.listen()
         addr = f"127.0.0.1:{self.server.port}"
         self.log.info("listening", addr=addr)
+        if self.obs is not None:
+            port = self.obs.start()
+            self.sampler.start(self.config.obs_sample_period_s)
+            self.log.info("obs endpoints up", addr=f"127.0.0.1:{port}")
         return addr
 
     def connect(
@@ -472,6 +526,10 @@ class ValidatorHost:
 
     def stop(self) -> None:
         self._stopping.set()
+        if self.sampler is not None:
+            self.sampler.stop()
+        if self.obs is not None:
+            self.obs.stop()
         self.server.stop()
         self._client.close()
         self.dispatcher.stop()

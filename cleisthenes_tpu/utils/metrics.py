@@ -14,7 +14,7 @@ import bisect
 import collections
 import threading
 import time
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from cleisthenes_tpu.utils.determinism import guarded_by
 
@@ -37,18 +37,43 @@ class Counter:
             return self._v
 
 
-@guarded_by("_lock", "_sorted", "_ring")
+# Default cumulative-bucket bounds for the Prometheus exposition
+# (seconds): epoch latencies span ~10 ms in-proc mini-clusters to
+# multi-minute N=128 message-passing epochs, so the ladder is
+# log-spaced across that whole range.
+DEFAULT_BUCKETS = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0,
+)
+
+
+@guarded_by(
+    "_lock", "_sorted", "_ring", "_bucket_counts", "_total_sum",
+    "_total_count",
+)
 class Histogram:
     """Sorted-reservoir histogram with exact percentiles.
 
     Bounded: keeps the most recent ``cap`` observations (epoch
     latencies arrive at network pace, so thousands of samples cover
-    hours of operation)."""
+    hours of operation).  Percentiles read the reservoir (a recency
+    window); the Prometheus export (``cumulative_buckets`` /
+    ``total_sum`` / ``total_count``) reads SEPARATE lifetime tallies
+    that only ever grow — the histogram type contract requires
+    monotonic counters, and reservoir eviction would read as counter
+    resets (spurious rate() spikes on dashboards)."""
 
-    def __init__(self, cap: int = 4096) -> None:
+    def __init__(
+        self, cap: int = 4096, buckets: Sequence[float] = DEFAULT_BUCKETS
+    ) -> None:
         self._sorted: List[float] = []
         self._ring: "collections.deque[float]" = collections.deque()
         self._cap = cap
+        self.bucket_bounds: List[float] = sorted(buckets)
+        # lifetime (monotonic) tallies for the Prometheus exposition
+        self._bucket_counts: List[int] = [0] * len(self.bucket_bounds)
+        self._total_sum = 0.0
+        self._total_count = 0
         self._lock = threading.Lock()
 
     def observe(self, v: float) -> None:
@@ -59,6 +84,11 @@ class Histogram:
                 self._sorted.pop(idx)
             self._ring.append(v)
             bisect.insort(self._sorted, v)
+            self._total_sum += v
+            self._total_count += 1
+            i = bisect.bisect_left(self.bucket_bounds, v)
+            if i < len(self._bucket_counts):
+                self._bucket_counts[i] += 1
 
     def percentile(self, p: float) -> Optional[float]:
         """p in [0, 100]; None when empty."""
@@ -71,8 +101,37 @@ class Histogram:
             )
             return self._sorted[idx]
 
+    def cumulative_buckets(self) -> List[Tuple[float, int]]:
+        """Prometheus-style cumulative buckets over the histogram's
+        LIFETIME: ``[(le, observations <= le), ...]`` ending with the
+        ``(inf, total)`` catch-all — monotonic counters per the
+        text-exposition ``_bucket{le=...}`` contract, never affected
+        by reservoir eviction."""
+        with self._lock:
+            out: List[Tuple[float, int]] = []
+            running = 0
+            for le, n in zip(self.bucket_bounds, self._bucket_counts):
+                running += n
+                out.append((le, running))
+            out.append((float("inf"), self._total_count))
+            return out
+
+    @property
+    def total_sum(self) -> float:
+        """Lifetime sum — the exposition's monotonic ``_sum``."""
+        with self._lock:
+            return self._total_sum
+
+    @property
+    def total_count(self) -> int:
+        """Lifetime observation count — the exposition's ``_count``."""
+        with self._lock:
+            return self._total_count
+
     @property
     def count(self) -> int:
+        """Reservoir size (bounded by ``cap``) — the percentile
+        window, NOT the exposition counter."""
         with self._lock:
             return len(self._ring)
 
@@ -117,7 +176,7 @@ class EpochTrace:
         return self.t_commit - self.t_acs_output
 
 
-@guarded_by("_lock", "_traces")
+@guarded_by("_lock", "_traces", "_last_commit_t")
 class Metrics:
     """Per-node metrics registry."""
 
@@ -138,6 +197,10 @@ class Metrics:
         self._traces: Dict[int, EpochTrace] = {}
         self._trace_cap = trace_cap
         self._t0 = time.monotonic()
+        # monotonic instant of the last committed epoch: the SLO
+        # watchdog's stall detector measures "time since progress"
+        # against this (never-committed reads as age since boot)
+        self._last_commit_t: Optional[float] = None
         self._lock = threading.Lock()
         # transport-health provider (transport.health.PeerHealthTracker
         # .snapshot, set by the host that owns the dial layer): folds a
@@ -153,6 +216,10 @@ class Metrics:
         # MAC rejections reachable without touching private transport
         # internals
         self._transport_stats: Optional[Callable[[], Dict]] = None
+        # SLO watchdog provider (utils.watchdog.SloWatchdog
+        # .alerts_block, set by the host/cluster that owns the
+        # watchdog): folds health + per-alert counters into snapshot()
+        self._alerts: Optional[Callable[[], Dict]] = None
 
     def set_transport_health(
         self, provider: Optional[Callable[[], Dict]]
@@ -168,6 +235,9 @@ class Metrics:
         self, provider: Optional[Callable[[], Dict]]
     ) -> None:
         self._trace_stats = provider
+
+    def set_alerts(self, provider: Optional[Callable[[], Dict]]) -> None:
+        self._alerts = provider
 
     def trace(self, epoch: int) -> EpochTrace:
         with self._lock:
@@ -189,6 +259,8 @@ class Metrics:
         tr = self.trace(epoch)
         tr.t_commit = time.monotonic()
         tr.n_txs = n_txs
+        with self._lock:  # read cross-thread by the SLO watchdog
+            self._last_commit_t = tr.t_commit
         self.epochs_committed.inc()
         self.txs_committed.inc(n_txs)
         if tr.total_s is not None:
@@ -201,6 +273,20 @@ class Metrics:
     def tx_per_sec(self) -> float:
         dt = time.monotonic() - self._t0
         return self.txs_committed.value / dt if dt > 0 else 0.0
+
+    def last_commit_age_s(self, now: Optional[float] = None) -> float:
+        """Seconds (monotonic) since the last committed epoch — since
+        construction when nothing committed yet.  ``now`` lets the
+        watchdog tests drive synthetic clocks."""
+        if now is None:
+            now = time.monotonic()
+        with self._lock:
+            base = (
+                self._last_commit_t
+                if self._last_commit_t is not None
+                else self._t0
+            )
+        return max(0.0, now - base)
 
     def snapshot(self) -> Dict[str, object]:
         """One flat dict for logging/export (the BASELINE metrics),
@@ -217,7 +303,14 @@ class Metrics:
             "acs_p50_s": self.acs_latency.p50,
             "decrypt_p50_s": self.decrypt_latency.p50,
         }
+        # every transport key is ALWAYS present (zeroed when no frame
+        # counters registered): scrapers and the timeseries sampler
+        # must never see a key appear/disappear between snapshots —
+        # nodes without a transport provider (bare HoneyBadger, early
+        # boot) used to omit delivered/rejected entirely
         transport: Dict[str, object] = {
+            "delivered": 0,
+            "rejected": 0,
             "dedup_absorbed": self.dedup_absorbed.value,
         }
         if self._transport_stats is not None:
@@ -227,7 +320,15 @@ class Metrics:
             out["transport_health"] = self._transport_health()
         if self._trace_stats is not None:
             out["trace"] = self._trace_stats()
+        if self._alerts is not None:
+            out["alerts"] = self._alerts()
         return out
 
 
-__all__ = ["Counter", "Histogram", "EpochTrace", "Metrics"]
+__all__ = [
+    "Counter",
+    "DEFAULT_BUCKETS",
+    "Histogram",
+    "EpochTrace",
+    "Metrics",
+]

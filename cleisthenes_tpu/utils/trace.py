@@ -64,6 +64,7 @@ CATEGORIES = frozenset(
         "transport",  # envelope coalescing, waves, queue depth
         "ledger",  # WAL appends / checkpoints
         "catchup",  # state-transfer requests/serves/adopts
+        "alert",  # SLO watchdog firings (epoch stall, backpressure…)
     )
 )
 

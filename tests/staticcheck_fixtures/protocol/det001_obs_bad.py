@@ -1,0 +1,23 @@
+"""Known-bad: a hand-rolled telemetry sampling loop in protocol code
+still gates even with the live telemetry plane landed.
+
+The telemetry plane's contract (docs/OBSERVABILITY.md) is that every
+wall-clock read lives in utils/ behind an audited ``allow[DET001]``
+pragma — ``utils/timeseries.py`` (the sampler tick) and
+``utils/watchdog.py`` (the stall clock) — and protocol code only ever
+*provides* state (pending counts, epoch frontiers) through callables.
+Inlining a sampler or a stall budget here must keep firing DET001:
+the pragmas are confined to those two files, not granted to the plane.
+"""
+
+import time
+
+
+def sample_metrics(series, snapshot):
+    # hand-rolled sampler tick instead of utils.timeseries
+    series.append((time.monotonic(), snapshot()))  # BAD:DET001
+
+
+def commit_stalled(last_commit_t, budget_s):
+    # hand-rolled stall detector instead of utils.watchdog
+    return time.monotonic() - last_commit_t > budget_s  # BAD:DET001

@@ -1,0 +1,391 @@
+"""perfgate: the perf-regression observatory's CI gate.
+
+BENCH_*.json files are ad-hoc snapshots: one number per round, no
+trend, nothing watching the trajectory between rounds.  This tool
+closes that gap with a durable append-only trend file
+(``BENCH_TREND.jsonl``, one JSON record per measured run keyed by a
+config fingerprint) and a gate that compares a fresh seeded mini-bench
+against the trailing trend with noise bands:
+
+- **epoch p50** regresses when the fresh median exceeds
+  ``max(trend_median * (1 + rel_tol), trend_median + abs_tol_ms)`` —
+  the relative band absorbs CI-host noise, the absolute floor keeps
+  tiny mini-bench epochs from turning microseconds of jitter into
+  failures.
+- **hub dispatches** (the cost model of this stack, and DETERMINISTIC
+  for a seeded run) regress when the fresh count exceeds the trend
+  maximum by more than ``dispatch_tol`` — a wave-batching regression
+  fails here with zero noise before it ever shows up in wall time.
+- **stage shares** (where the epoch's wall time goes, from the PR-3
+  critical-path attribution) regress when any stage's share grows by
+  more than ``share_tol`` absolute — a latency leak that hides inside
+  an unchanged total still moves its stage's share.
+
+Workflow (the ci.sh stage):
+
+    python -m tools.perfgate --trend BENCH_TREND.jsonl
+
+First run seeds the trend (pass); later runs gate against the trailing
+``--window`` records with a matching fingerprint and append on pass,
+so the band tracks legitimate drift.  After an INTENTIONAL perf change
+(more dispatches by design, a new stage), refresh with ``--reset``.
+``--record FILE`` gates a pre-measured record instead of running the
+mini-bench — the test hook proving the gate actually fails on an
+inflated epoch p50.
+
+``bench.py`` appends every full benchmark run's sections through
+``append_bench_trend`` so the headline numbers build the same history.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import statistics
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+DEFAULT_TREND = REPO_ROOT / "BENCH_TREND.jsonl"
+
+# mini-bench shape: small enough for a CI stage (~seconds), big enough
+# that epoch p50 moves when the protocol path regresses
+MINI_N = 4
+MINI_BATCH = 64
+MINI_EPOCHS = 3
+MINI_SEED = 1999
+
+DEFAULT_WINDOW = 20
+DEFAULT_REL_TOL = 1.0  # fresh p50 may double before failing (CI noise)
+DEFAULT_ABS_TOL_MS = 50.0
+DEFAULT_SHARE_TOL = 0.25
+DEFAULT_DISPATCH_TOL = 1.25
+
+
+# ---------------------------------------------------------------------------
+# trend file
+# ---------------------------------------------------------------------------
+
+
+def fingerprint_key(record: Dict) -> str:
+    """Stable comparison key: records gate only against runs of the
+    identical configuration."""
+    return json.dumps(record.get("fingerprint", {}), sort_keys=True)
+
+
+def load_trend(path: str) -> List[Dict]:
+    """Every parseable record, file order (oldest first).  A corrupt
+    line (torn write) is skipped, never fatal — the trend is an aid,
+    not a ledger."""
+    out: List[Dict] = []
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(rec, dict):
+                    out.append(rec)
+    except OSError:
+        return []
+    return out
+
+
+def append_record(path: str, record: Dict) -> None:
+    with open(path, "a", encoding="utf-8") as fh:
+        fh.write(json.dumps(record, sort_keys=True) + "\n")
+
+
+def append_bench_trend(result: Dict, path: str = str(DEFAULT_TREND)) -> int:
+    """Fold one bench.py artifact into the trend: a record per
+    protocol section per backend that produced an epoch p50.  Returns
+    the number of records appended; never raises (bench output must
+    not become hostage to trend bookkeeping)."""
+    appended = 0
+    try:
+        stamp = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        platform = result.get("platform")
+        for section, body in result.items():
+            if not isinstance(body, dict):
+                continue
+            for backend in ("tpu", "cpu"):
+                side = body.get(backend)
+                if not isinstance(side, dict):
+                    continue
+                p50 = side.get("epoch_p50_ms")
+                if p50 is None:
+                    continue
+                record = {
+                    "kind": "bench_section",
+                    "ts": stamp,
+                    "fingerprint": {
+                        "kind": "bench_section",
+                        "section": section,
+                        "backend": backend,
+                        "platform": platform,
+                        "n": body.get("n"),
+                        "batch": body.get("batch"),
+                    },
+                    "epoch_p50_ms": p50,
+                    "epoch_times_ms": side.get("epoch_times_ms"),
+                    "tx_per_sec": side.get("tx_per_sec"),
+                    "stage_shares": side.get("stage_shares"),
+                    "hub_dispatches": side.get("hub_dispatches_cluster"),
+                }
+                append_record(path, record)
+                appended += 1
+    except OSError:
+        pass
+    return appended
+
+
+# ---------------------------------------------------------------------------
+# the seeded mini-bench
+# ---------------------------------------------------------------------------
+
+
+def run_sample(
+    n: int = MINI_N,
+    batch: int = MINI_BATCH,
+    epochs: int = MINI_EPOCHS,
+    seed: int = MINI_SEED,
+) -> Dict:
+    """One seeded traced mini-bench over the in-proc cluster: epoch
+    walls, stage shares, wave sizes, hub dispatch count."""
+    from cleisthenes_tpu.config import Config
+    from cleisthenes_tpu.protocol.cluster import SimulatedCluster
+    from cleisthenes_tpu.utils.trace import to_chrome
+    from tools import tracetool
+
+    cluster = SimulatedCluster(
+        config=Config(
+            n=n, batch_size=batch, seed=seed, trace=True,
+            crypto_backend="cpu",
+        ),
+        seed=seed,
+        key_seed=7,
+        auto_propose=False,
+    )
+    ids = cluster.ids
+    total = batch * (epochs + 1)  # +1: the warm-up epoch's own txs
+    for i in range(total):
+        cluster.submit(b"perfgate-%08d" % i, node_id=ids[i % n])
+    for hb in cluster.nodes.values():  # warm-up epoch (compile, caches)
+        hb.start_epoch()
+    cluster.net.run()
+    walls: List[float] = []
+    for _ in range(epochs):
+        t0 = time.perf_counter()
+        for hb in cluster.nodes.values():
+            hb.start_epoch()
+        cluster.net.run()
+        walls.append(time.perf_counter() - t0)
+    cluster.assert_agreement()
+    doc = to_chrome(cluster.trace_events())
+    summary = tracetool.summarize(doc)
+    p50 = statistics.median(walls)
+    p95 = sorted(walls)[max(0, int(round(0.95 * (len(walls) - 1))))]
+    return {
+        "kind": "perfgate_mini",
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "fingerprint": {
+            "kind": "perfgate_mini",
+            "n": n,
+            "batch": batch,
+            "epochs": epochs,
+            "seed": seed,
+            "backend": "cpu",
+        },
+        "epoch_p50_ms": round(p50 * 1000.0, 3),
+        "epoch_p95_ms": round(p95 * 1000.0, 3),
+        "epoch_times_ms": [round(w * 1000.0, 1) for w in walls],
+        "stage_shares": tracetool.stage_shares(doc),
+        "wave_size_p50": summary["wave_size_p50"],
+        "wave_size_p95": summary["wave_size_p95"],
+        "hub_dispatches": int(
+            cluster.nodes[ids[0]].hub.stats()["dispatches"]
+        ),
+    }
+
+
+# ---------------------------------------------------------------------------
+# the gate
+# ---------------------------------------------------------------------------
+
+
+def compare(
+    fresh: Dict,
+    trend: List[Dict],
+    rel_tol: float = DEFAULT_REL_TOL,
+    abs_tol_ms: float = DEFAULT_ABS_TOL_MS,
+    share_tol: float = DEFAULT_SHARE_TOL,
+    dispatch_tol: float = DEFAULT_DISPATCH_TOL,
+) -> Tuple[bool, List[str]]:
+    """(ok, reasons): gate ``fresh`` against same-fingerprint ``trend``
+    records (the caller already windowed and filtered them)."""
+    reasons: List[str] = []
+    p50s = [
+        r["epoch_p50_ms"]
+        for r in trend
+        if isinstance(r.get("epoch_p50_ms"), (int, float))
+    ]
+    if p50s:
+        med = statistics.median(p50s)
+        limit = max(med * (1.0 + rel_tol), med + abs_tol_ms)
+        fresh_p50 = fresh.get("epoch_p50_ms")
+        if not isinstance(fresh_p50, (int, float)):
+            reasons.append("fresh record carries no epoch_p50_ms")
+        elif fresh_p50 > limit:
+            reasons.append(
+                f"epoch p50 regression: {fresh_p50:.3f} ms > "
+                f"noise-band limit {limit:.3f} ms "
+                f"(trend median {med:.3f} ms over {len(p50s)} runs)"
+            )
+    dispatches = [
+        r["hub_dispatches"]
+        for r in trend
+        if isinstance(r.get("hub_dispatches"), int)
+    ]
+    fresh_disp = fresh.get("hub_dispatches")
+    if dispatches and isinstance(fresh_disp, int):
+        cap = max(dispatches) * dispatch_tol
+        if fresh_disp > cap:
+            reasons.append(
+                f"hub dispatch regression: {fresh_disp} > "
+                f"{cap:.0f} (trend max {max(dispatches)} * "
+                f"{dispatch_tol}); the seeded run is deterministic — "
+                "this is a wave-batching change, not noise "
+                "(--reset if intentional)"
+            )
+    trend_shares = [
+        r["stage_shares"]
+        for r in trend
+        if isinstance(r.get("stage_shares"), dict) and r["stage_shares"]
+    ]
+    fresh_shares = fresh.get("stage_shares")
+    if trend_shares and isinstance(fresh_shares, dict):
+        stages = {s for shares in trend_shares for s in shares}
+        for stage in sorted(stages | set(fresh_shares)):
+            med_share = statistics.median(
+                [float(s.get(stage, 0.0)) for s in trend_shares]
+            )
+            got = float(fresh_shares.get(stage, 0.0))
+            if got - med_share > share_tol:
+                reasons.append(
+                    f"stage-share regression: {stage} owns "
+                    f"{got:.2%} of epoch wall vs trend median "
+                    f"{med_share:.2%} (+>{share_tol:.0%})"
+                )
+    return (not reasons), reasons
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(prog="tools.perfgate")
+    ap.add_argument(
+        "--trend", default=str(DEFAULT_TREND),
+        help=f"trend JSONL path (default {DEFAULT_TREND.name})",
+    )
+    ap.add_argument(
+        "--record", metavar="JSON",
+        help="gate this pre-measured record file instead of running "
+        "the mini-bench (never appended)",
+    )
+    ap.add_argument("--window", type=int, default=DEFAULT_WINDOW)
+    ap.add_argument("--rel-tol", type=float, default=DEFAULT_REL_TOL)
+    ap.add_argument("--abs-tol-ms", type=float, default=DEFAULT_ABS_TOL_MS)
+    ap.add_argument("--share-tol", type=float, default=DEFAULT_SHARE_TOL)
+    ap.add_argument(
+        "--dispatch-tol", type=float, default=DEFAULT_DISPATCH_TOL
+    )
+    ap.add_argument(
+        "--no-append", action="store_true",
+        help="gate only; do not extend the trend on pass",
+    )
+    ap.add_argument(
+        "--reset", action="store_true",
+        help="drop same-fingerprint history first (after an "
+        "INTENTIONAL perf change) and reseed from this run",
+    )
+    ap.add_argument("--n", type=int, default=MINI_N)
+    ap.add_argument("--batch", type=int, default=MINI_BATCH)
+    ap.add_argument("--epochs", type=int, default=MINI_EPOCHS)
+    ap.add_argument("--seed", type=int, default=MINI_SEED)
+    args = ap.parse_args(argv)
+
+    if args.record:
+        with open(args.record, "r", encoding="utf-8") as fh:
+            fresh = json.load(fh)
+    else:
+        fresh = run_sample(
+            n=args.n, batch=args.batch, epochs=args.epochs, seed=args.seed
+        )
+    key = fingerprint_key(fresh)
+    trend_all = load_trend(args.trend)
+    if args.reset:
+        kept = [r for r in trend_all if fingerprint_key(r) != key]
+        tmp = args.trend + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            for r in kept:
+                fh.write(json.dumps(r, sort_keys=True) + "\n")
+        os.replace(tmp, args.trend)
+        trend_all = kept
+    matching = [r for r in trend_all if fingerprint_key(r) == key]
+    matching = matching[-args.window:]
+
+    if not matching:
+        if args.record:
+            print(
+                "perfgate: no trend history for this fingerprint and "
+                "--record given; nothing to gate against"
+            )
+            return 0
+        append_record(args.trend, fresh)
+        print(
+            f"perfgate: seeded trend {args.trend} "
+            f"(epoch p50 {fresh['epoch_p50_ms']} ms, "
+            f"{fresh.get('hub_dispatches')} hub dispatches) — PASS"
+        )
+        return 0
+
+    ok, reasons = compare(
+        fresh,
+        matching,
+        rel_tol=args.rel_tol,
+        abs_tol_ms=args.abs_tol_ms,
+        share_tol=args.share_tol,
+        dispatch_tol=args.dispatch_tol,
+    )
+    med = statistics.median(
+        [
+            r["epoch_p50_ms"]
+            for r in matching
+            if isinstance(r.get("epoch_p50_ms"), (int, float))
+        ]
+        or [0.0]
+    )
+    if ok:
+        if not args.record and not args.no_append:
+            append_record(args.trend, fresh)
+        print(
+            f"perfgate: PASS — epoch p50 "
+            f"{fresh.get('epoch_p50_ms')} ms within band of trend "
+            f"median {med:.3f} ms ({len(matching)} run(s))"
+        )
+        return 0
+    print("perfgate: FAIL")
+    for r in reasons:
+        print(f"  - {r}")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
