@@ -310,7 +310,21 @@ def measure_protocol(
         "hub_dispatches_cluster": int(
             nodes[node_ids[0]].hub.stats()["dispatches"]
         ),
+        # wave-columnar counters (ISSUE 7): how wide the hub's flush
+        # columns ran and how few dispatches an epoch needed — the
+        # numbers the columnar refactor is supposed to move
+        "dispatches_per_epoch": round(
+            nodes[node_ids[0]].hub.stats()["dispatches"]
+            / max(1, epochs + 1),  # +1: warm-up epoch dispatches too
+            1,
+        ),
     }
+    widths = sorted(nodes[node_ids[0]].hub.wave_widths)
+    if widths:
+        out["wave_width_p50"] = widths[len(widths) // 2]
+        out["wave_width_p95"] = widths[
+            max(0, int(round(0.95 * (len(widths) - 1))))
+        ]
     if trace:
         from cleisthenes_tpu.utils.trace import to_chrome
         from tools import tracetool

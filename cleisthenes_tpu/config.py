@@ -120,6 +120,14 @@ class Config:
     # and VAL/ECHO exchange overlap e's decryption-share phase.
     # Commit order is unaffected (commits gate on the epoch counter).
     epoch_pipelining: bool = True
+    # Wave-deferred hub flushing (the columnar fast path): on
+    # transports that promise an idle callback, batched crypto runs
+    # ONLY at quiescence points, one columnar flush per message wave.
+    # False reverts to the pre-wave scalar discipline — every quorum
+    # event flushes the hub immediately — kept as the comparison arm
+    # of the cross-path equivalence test (seeded runs must commit
+    # byte-identical ledgers under either discipline).
+    hub_wave_flush: bool = True
 
     def __post_init__(self) -> None:
         if self.n < 1:

@@ -1026,6 +1026,24 @@ class Tpke:
     ) -> DhShare:
         return issue_share(share, ct.c1, self._context(ct), self.group)
 
+    def dec_share_batch(
+        self, share: ThresholdSecretShare, cts: Sequence[Ciphertext]
+    ) -> List[DhShare]:
+        """All of an epoch's decryption shares in ONE batched
+        exponentiation dispatch and one CP-nonce entropy draw —
+        semantically ``[dec_share(share, ct) for ct in cts]`` (the
+        wave-columnar protocol path's issue seam; scalar dec_share
+        was N 4-exp calls + N urandom reads per node per epoch)."""
+        if not cts:
+            return []
+        vk = self.pub.verification_keys[share.index - 1]
+        return issue_shares_batch(
+            [(share, ct.c1, self._context(ct), vk) for ct in cts],
+            group=self.group,
+            backend=self.backend,
+            mesh=self.mesh,
+        )
+
     def verify_dec_shares(
         self, ct: Ciphertext, shares: Sequence[DhShare]
     ) -> List[bool]:

@@ -520,7 +520,7 @@ class BBA:
 
     # -- hub client protocol (protocol.hub.CryptoHub) ----------------------
 
-    def collect_crypto_work(self, branches, decodes, shares) -> None:
+    def drain_pending(self, wave) -> None:
         if self.halted:
             return
         r = self._rounds.get(self.round)
@@ -540,17 +540,13 @@ class BBA:
             self._coin_id(self.round)
         )
         rnd = self.round
-        shares.append(
-            (
-                pub,
-                base,
-                context,
-                senders,
-                shs,
-                lambda snd, ok, rnd=rnd: self._on_coin_verdicts(
-                    rnd, snd, ok
-                ),
-            )
+        wave.add_share(
+            pub,
+            base,
+            context,
+            senders,
+            shs,
+            lambda snd, ok, rnd=rnd: self._on_coin_verdicts(rnd, snd, ok),
         )
 
     def _on_coin_verdicts(self, rnd: int, senders, ok) -> None:

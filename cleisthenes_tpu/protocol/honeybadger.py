@@ -597,9 +597,13 @@ class HoneyBadger:
         phase; SerialDispatcher's empty-mailbox check).  Moves outbound
         flushing and batched-crypto execution to those points, so one
         hub flush + one bundle per receiver absorbs an entire message
-        wave."""
+        wave.  ``Config.hub_wave_flush=False`` keeps the hub on the
+        pre-wave scalar discipline (flush per quorum event) — the
+        equivalence-test comparison arm; outbound coalescing still
+        moves to the idle callback either way."""
         self._transport_managed = True
-        self.hub.defer = True
+        if self.config.hub_wave_flush:
+            self.hub.defer = True
 
     def flush_outbound(self) -> None:
         self._coalesce.flush()
@@ -808,6 +812,8 @@ class HoneyBadger:
         # span start AFTER the pipelined next-epoch proposal: the
         # share-issue stage must not absorb epoch e+1's encode time
         t_share0 = 0.0 if tr is None else tr.now()
+        issue_cts = []
+        issue_proposers = []
         for proposer, ct_bytes in output.items():
             try:
                 ct = deserialize_ciphertext(
@@ -819,7 +825,16 @@ class HoneyBadger:
                 es.decrypted[proposer] = None
                 continue
             es.ciphertexts[proposer] = ct
-            share = self.tpke.dec_share(self.keys.tpke_share, ct)
+            issue_cts.append(ct)
+            issue_proposers.append(proposer)
+        # ALL of the epoch's decryption shares issue in ONE batched
+        # exponentiation dispatch (and one CP-nonce entropy draw) —
+        # per-proposer tpke.dec_share was N scalar 4-exp calls plus N
+        # urandom reads per node per epoch on the commit critical path
+        dec_shares = self.tpke.dec_share_batch(
+            self.keys.tpke_share, issue_cts
+        )
+        for proposer, share in zip(issue_proposers, dec_shares):
             self.out.broadcast(
                 DecSharePayload(
                     proposer=proposer,
@@ -972,7 +987,7 @@ class HoneyBadger:
 
     # -- hub client protocol (protocol.hub.CryptoHub) ----------------------
 
-    def collect_crypto_work(self, branches, decodes, shares) -> None:
+    def drain_pending(self, wave) -> None:
         for epoch, es in self._epochs.items():
             if es.output is None or es.committed:
                 continue
@@ -989,17 +1004,15 @@ class HoneyBadger:
                 senders, shs = pool.collect_pending(pool.need_more())
                 if not senders:
                     continue
-                shares.append(
-                    (
-                        self.keys.tpke_pub,
-                        ct.c1,
-                        self.tpke.context(ct),
-                        senders,
-                        shs,
-                        lambda snd, ok, pool=pool: self._on_dec_verdicts(
-                            pool, snd, ok
-                        ),
-                    )
+                wave.add_share(
+                    self.keys.tpke_pub,
+                    ct.c1,
+                    self.tpke.context(ct),
+                    senders,
+                    shs,
+                    lambda snd, ok, pool=pool: self._on_dec_verdicts(
+                        pool, snd, ok
+                    ),
                 )
 
     def _on_dec_verdicts(self, pool, senders, ok) -> None:

@@ -1,4 +1,4 @@
-"""CryptoHub: cross-instance batched crypto for the live protocol path.
+"""CryptoHub: columnar wave-batched crypto for the live protocol path.
 
 The reference's cost model is N^2 ECHO-phase Merkle verifications and
 ~4N^2 threshold-share verifications per epoch (reference
@@ -10,13 +10,26 @@ roots, unverified threshold shares) in their own state and the hub
 pulls and executes it in BATCHED dispatches when some instance's
 quorum threshold makes results necessary.
 
+Wave-columnar execution (the Thetacrypt "threshold crypto as a
+service with request coalescing" shape, PAPERS.md 2502.03247): the
+transport's idle callback is the only flush trigger on both
+transports, and one flush drains EVERY dirty client of the wave into
+a handful of wide typed columns — all pending ECHO-branch proofs,
+all ready RS decode-rechecks, all pooled coin/TPKE shares — then
+executes ONE batch call per work kind, in dependency order
+(branches -> decodes -> shares), and fans verdicts back out via the
+client callback protocol.  Branch verdicts can unlock decodes
+(verified shards complete a staged matrix); the hub re-drains
+verdict-marked clients *within the same wave round* so those decodes
+ride the round's single decode dispatch instead of a follow-on one.
+
 Why pull, not push: the work lives where the protocol state lives, so
 an instance that becomes irrelevant mid-flight (delivered, halted,
 epoch GC'd) simply stops offering work — no queue invalidation.  And
-because EVERY registered instance's pending work is collected whenever
-ANY instance needs a flush, one instance reaching quorum amortizes the
-whole node's backlog into the same dispatch: under 'tpu', an epoch's
-N instances' ECHO proofs verify in ~1 `verify_batch` call instead of
+because EVERY dirty client's pending work drains whenever ANY client
+needs a flush, one instance reaching quorum amortizes the whole
+node's backlog into the same dispatch: under 'tpu', an epoch's N
+instances' ECHO proofs verify in ~1 `verify_batch` call instead of
 N^2 singleton calls, and all TPKE + coin shares fold into ONE
 dual-exponentiation dispatch via tpke.verify_share_groups.
 
@@ -25,41 +38,49 @@ Client protocol (duck-typed; see RBC/BBA/HoneyBadger):
   hub.mark_dirty(client)
       REQUIRED whenever pending crypto work appears or becomes
       unblocked (parked branch, staged decode, pooled share); a flush
-      round polls only dirty clients
-  collect_crypto_work(branches, decodes, shares) -> None
-      append pending work items; pending state moves to in-flight
+      round drains only dirty clients
+  drain_pending(wave: HubWave) -> None
+      move pending work out of client state into the wave's typed
+      columns (wave.add_branch / add_decode / add_share); a client
+      may be drained more than once per round and must only offer
+      each work item once
   after_crypto_flush() -> None
       verdicts have been applied via item callbacks; run quorum logic
 
-Work item shapes:
-  branches: (root: bytes32, leaf: bytes, branch: tuple[bytes32,...],
-             index: int, client, ctx) -- verdicts deliver in bulk via
-             client.on_branch_verdicts(ctxs, oks), one call per client
-             per flush (a per-item closure was ~5% of an N=64 epoch)
-  decodes:  (idxs: tuple[int,...], shards: (k, L) uint8 ndarray,
-             root: bytes32, cb(data: Optional[ndarray]))
-             -- decode + re-encode + Merkle-root recheck
-             (docs/RBC-EN.md:37-39) batched across instances
-  shares:   (pub, base: int, context: bytes, senders: list[str],
-             shares: list[DhShare], cb(verdicts: list[bool]))
-
-The flush loop iterates because verdicts unlock follow-on work (ECHO
-verifies add shards -> a root becomes decodable -> decode next pass);
-it terminates when a collection round yields nothing.
+Work item shapes (the wave's typed columns):
+  branches: add_branch(client, root: bytes32, leaf: bytes,
+            branch: tuple[bytes32,...], index: int, ctx) — verdicts
+            deliver in bulk via client.on_branch_verdicts(ctxs, oks),
+            one call per client per dispatch (a per-item closure was
+            ~5% of an N=64 epoch).  Duplicate work across clients
+            dedups AT APPEND TIME by object identity (dedup mode):
+            an in-proc cluster's N receivers share one decoded
+            payload's root/leaf/branch objects, so the content-key
+            memo is consulted once per distinct check, not once per
+            (check, receiver).
+  decodes:  add_decode(root: bytes32, idxs: tuple[int,...],
+            shards: list[bytes] (k branch-verified shards, idxs
+            order), cb(data: Optional[ndarray])) — decode + re-encode
+            + Merkle-root recheck (docs/RBC-EN.md:37-39) batched
+            across instances; the hub builds each unique matrix once.
+  shares:   add_share(pub, base: int, context: bytes,
+            senders: list[str], shares: list[DhShare],
+            cb(senders, verdicts: list[bool]))
 """
 
 from __future__ import annotations
 
-from typing import Callable, Dict, List, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from cleisthenes_tpu.ops.backend import BatchCrypto
 from cleisthenes_tpu.ops.tpke import verify_share_groups
 
-# A flush settles in 2-3 collection rounds (verify -> decode -> quorum
-# actions); the cap only guards against a pathological client that
-# re-offers work forever.
+# A flush settles in 1-2 wave rounds (branch verdicts unlock decodes
+# WITHIN a round; only share burns and quorum follow-ons need another);
+# the cap only guards against a pathological client that re-offers
+# work forever.
 MAX_FLUSH_ROUNDS = 64
 
 # Verdict-memo capacities.  Primary eviction is epoch GC (drop_scope
@@ -67,15 +88,23 @@ MAX_FLUSH_ROUNDS = 64
 # stale entries never pay their rent back); the caps are a second
 # bound for pathological single-epoch volume, sized per entry weight:
 # share keys are a few hundred bytes (big-int triples), branch keys
-# carry a leaf + branch path (~KB), decode keys carry the full shard
-# matrix (~10s of KB).
+# carry a leaf + branch path (~KB), decode keys are (root, idxs).
 SHARE_MEMO_CAP = 1 << 16
 BRANCH_MEMO_CAP = 1 << 15
 DECODE_MEMO_CAP = 1 << 10
 
+# wave-width samples kept for bench percentiles (protocol sections
+# report wave_width_p50/p95); a run's flushes far exceed this only in
+# pathological schedules, and old samples are as good as new ones
+WAVE_WIDTH_CAP = 1 << 16
+
 
 class _Memo:
-    """Bounded memo of pure-function results (cleared on overflow)."""
+    """Bounded memo of pure-function results with FIFO eviction: at
+    the cap, the OLDEST insertion is evicted (dict order), never the
+    whole table — a hot epoch sitting near the cap loses one stale
+    entry per fresh one instead of periodically dropping everything
+    and re-verifying the wave's N^2 checks from scratch."""
 
     __slots__ = ("map", "cap")
 
@@ -84,9 +113,89 @@ class _Memo:
         self.cap = cap
 
     def put(self, key, val) -> None:
-        if len(self.map) >= self.cap:
-            self.map.clear()
-        self.map[key] = val
+        m = self.map
+        if len(m) >= self.cap and key not in m:
+            del m[next(iter(m))]  # FIFO: oldest insertion goes first
+        m[key] = val
+
+
+class HubWave:
+    """One flush's typed work columns.
+
+    Branch items are slotted: each append lands a (client, ctx, slot)
+    row, where ``slot`` indexes the unique-work list.  In dedup mode
+    (cluster-shared hub) uniqueness is established at APPEND time by
+    object identity — the in-proc transport's payload memo hands every
+    receiver the same root/leaf/branch objects, so id-keying collapses
+    a wave's N copies of one check to a single slot without hashing
+    any content.  Ids are only compared between live objects held by
+    this wave (the columns pin them), so reuse-after-GC cannot alias.
+    Decode and share items stay flat lists — their populations are
+    ~N per wave, not ~N^2.
+    """
+
+    __slots__ = (
+        "dedup",
+        "b_slots",
+        "b_items",
+        "_b_ids",
+        "decodes",
+        "shares",
+        "clients",
+    )
+
+    def __init__(self, dedup: bool) -> None:
+        self.dedup = dedup
+        self.b_slots: List[Tuple] = []  # unique (root, leaf, branch, idx)
+        self.b_items: List[Tuple] = []  # (client, ctx, slot)
+        self._b_ids: Dict[Tuple, int] = {}
+        self.decodes: List[Tuple] = []  # (root, idxs, [shards], cb)
+        self.shares: List[Tuple] = []  # (pub, base, ctx, senders, shs, cb)
+        self.clients: List[object] = []  # drained clients, arrival order
+
+    def add_branch(
+        self, client, root: bytes, leaf: bytes, branch: tuple,
+        index: int, ctx,
+    ) -> None:
+        slots = self.b_slots
+        if self.dedup:
+            key = (id(root), id(leaf), id(branch), index)
+            slot = self._b_ids.get(key)
+            if slot is None:
+                slot = len(slots)
+                self._b_ids[key] = slot
+                slots.append((root, leaf, branch, index))
+        else:
+            slot = len(slots)
+            slots.append((root, leaf, branch, index))
+        self.b_items.append((client, ctx, slot))
+
+    def add_decode(self, root: bytes, idxs: tuple, shards: list, cb) -> None:
+        self.decodes.append((root, idxs, shards, cb))
+
+    def add_share(
+        self, pub, base: int, context: bytes, senders: list, shares: list,
+        cb,
+    ) -> None:
+        self.shares.append((pub, base, context, senders, shares, cb))
+
+    def has_work(self) -> bool:
+        return bool(self.b_items or self.decodes or self.shares)
+
+    def take_branches(self) -> Tuple[List[Tuple], List[Tuple]]:
+        slots, items = self.b_slots, self.b_items
+        self.b_slots, self.b_items = [], []
+        if self._b_ids:
+            self._b_ids = {}
+        return slots, items
+
+    def take_decodes(self) -> List[Tuple]:
+        out, self.decodes = self.decodes, []
+        return out
+
+    def take_shares(self) -> List[Tuple]:
+        out, self.shares = self.shares, []
+        return out
 
 
 class CryptoHub:
@@ -103,9 +212,12 @@ class CryptoHub:
     work stays honest, only the single-process serialization artifact
     (N x the same pure computation, run serially) is removed.  Memo
     keys bind every input the verdict depends on (group, public-key
-    identity, base, context, share values / root, leaf, branch, index),
-    so two different-content messages can never share a verdict.
-    Per-node hubs in a real deployment leave this off: nothing repeats.
+    identity, base, context, share values / root, leaf, branch,
+    index); decode keys bind (root, idxs) — sufficient because only
+    BRANCH-VERIFIED shards ever reach a decode request, and two
+    different shard byte-strings verifying at the same index under
+    the same root would be a SHA-256 second preimage.  Per-node hubs
+    in a real deployment leave dedup off: nothing repeats.
     """
 
     def __init__(self, crypto: BatchCrypto, dedup: bool = False):
@@ -124,12 +236,12 @@ class CryptoHub:
         self._clients: Dict[object, List[object]] = {}
         # Clients with (possibly) pending work: every state change
         # that creates or unblocks crypto work calls mark_dirty, and a
-        # flush round polls ONLY drained-dirty clients — at N
-        # validators x N instances, polling every registered client
-        # every round was a top-5 epoch cost.  A client that stages
-        # work without marking itself dirty will stall: marking is
-        # part of the client protocol (see class docstring).
-        # An insertion-ordered dict-as-set, NOT a set: flush order
+        # flush round drains ONLY dirty clients — at N validators x N
+        # instances, polling every registered client every round was a
+        # top-5 epoch cost.  A client that stages work without marking
+        # itself dirty will stall: marking is part of the client
+        # protocol (see module docstring).
+        # An insertion-ordered dict-as-set, NOT a set: drain order
         # decides the order work items batch and verdict callbacks
         # fire, which decides outbound payload order — id()-hash set
         # order would let two runs of the same seeded schedule ship
@@ -138,11 +250,11 @@ class CryptoHub:
         self._flushing = False
         # Deferred mode (HoneyBadger.transport_manages_idle sets
         # ``hub.defer = True`` when its transport promises an idle
-        # callback): request_flush only
-        # records the want; the actual flush runs at the transport's
-        # quiescence point, so one flush absorbs the whole message
-        # wave's pending work instead of firing per quorum event —
-        # VERDICT round 2's dispatch-count lever (item 2).
+        # callback): request_flush only records the want; the actual
+        # flush runs at the transport's quiescence point — the ONLY
+        # flush trigger on both transports — so one flush absorbs the
+        # whole message wave's pending work instead of firing per
+        # quorum event.
         self.defer = False
         self.flush_wanted = False
         # observability (utils.metrics reads these)
@@ -151,6 +263,10 @@ class CryptoHub:
         self.decode_items = 0
         self.share_items = 0
         self.dispatches = 0
+        # per-flush total column width (branch+decode+share items) of
+        # every flush that carried work, for the bench's
+        # wave_width_p50/p95 counters (bounded; see WAVE_WIDTH_CAP)
+        self.wave_widths: List[int] = []
         # flight recorder (utils/trace.py).  Per-node hubs inherit
         # the owner's recorder; a cluster-SHARED hub gets its own
         # "hub" track (its flushes serve the whole roster and belong
@@ -188,9 +304,9 @@ class CryptoHub:
     # -- flushing ----------------------------------------------------------
 
     def request_flush(self) -> None:
-        """Run a flush now — unless one is already running (its
-        collection loop will pick the new work up) or deferred mode
-        parks the request for the transport's idle callback."""
+        """Run a flush now — unless one is already running (its wave
+        loop will pick the new work up) or deferred mode parks the
+        request for the transport's idle callback."""
         if self._flushing:
             return
         if self.defer:
@@ -205,7 +321,22 @@ class CryptoHub:
             self.flush_wanted = False
             self.flush()
 
+    def _drain_dirty(self, wave: HubWave) -> None:
+        clients = list(self._dirty)
+        self._dirty.clear()
+        for c in clients:
+            c.drain_pending(wave)
+        wave.clients.extend(clients)
+
     def flush(self) -> None:
+        """Drain every dirty client into typed columns and execute one
+        batch dispatch per work kind, in dependency order.  Branch
+        verdicts that unlock decodes re-mark their client; the
+        mid-round re-drain folds those decodes into the SAME round's
+        decode dispatch.  The loop iterates only when verdicts create
+        genuinely new work (a share burn pulling parked replacements,
+        quorum logic staging follow-ons); it terminates when a round
+        neither executed work nor left dirty clients."""
         if self._flushing:
             return
         self._flushing = True
@@ -219,32 +350,40 @@ class CryptoHub:
             self.decode_items,
             self.share_items,
         )
+        rounds = 0
         try:
+            wave = HubWave(self.dedup)
             for _ in range(MAX_FLUSH_ROUNDS):
-                if not self._dirty:
+                if self._dirty:
+                    self._drain_dirty(wave)
+                if not wave.has_work():
                     break
-                clients = list(self._dirty)
-                self._dirty.clear()
-                branches: List[Tuple] = []
-                decodes: List[Tuple] = []
-                shares: List[Tuple] = []
-                for c in clients:
-                    c.collect_crypto_work(branches, decodes, shares)
-                if not (branches or decodes or shares):
-                    break
-                if branches:
-                    self._run_branches(branches)
-                if decodes:
-                    self._run_decodes(decodes)
-                if shares:
-                    self._run_shares(shares)
-                # executor callbacks may re-mark clients (e.g. a
-                # verified ECHO shard completes a staged decode); the
-                # next loop round drains them
-                for c in clients:
+                rounds += 1
+                if wave.b_items:
+                    self._run_branches(*wave.take_branches())
+                    if self._dirty:
+                        # verdicts unlocked work (a completed decode
+                        # matrix): drain it into THIS round's columns
+                        self._drain_dirty(wave)
+                if wave.decodes:
+                    self._run_decodes(wave.take_decodes())
+                if wave.shares:
+                    self._run_shares(wave.take_shares())
+                # executor callbacks may re-mark clients (e.g. a share
+                # burn with parked replacements); quorum logic runs on
+                # every client drained this round, in drain order
+                clients, wave.clients = wave.clients, []
+                for c in dict.fromkeys(clients):
                     c.after_crypto_flush()
         finally:
             self._flushing = False
+            width = (
+                (self.branch_items - b0)
+                + (self.decode_items - k0)
+                + (self.share_items - s0)
+            )
+            if width and len(self.wave_widths) < WAVE_WIDTH_CAP:
+                self.wave_widths.append(width)
             if tr is not None:
                 tr.complete(
                     "hub",
@@ -254,56 +393,59 @@ class CryptoHub:
                     branches=self.branch_items - b0,
                     decodes=self.decode_items - k0,
                     shares=self.share_items - s0,
+                    wave_width=width,
+                    rounds=rounds,
                 )
 
     # -- executors ---------------------------------------------------------
 
-    def _run_branches(self, items: List[Tuple]) -> None:
+    def _run_branches(
+        self, slots: List[Tuple], items: List[Tuple]
+    ) -> None:
         """Branch proofs grouped by (depth, leaf length) — one
         merkle.verify_batch per group (trees of one roster share a
-        depth, so this is ~one group per epoch).  Verdicts deliver in
-        BULK per client (``on_branch_verdicts(ctxs, oks)``): a wave's
-        N^2 echoes cost one call per instance, not one closure each."""
+        depth, so this is ~one group per wave).  Content-key memo
+        lookups run per unique SLOT (the wave already id-deduped the
+        N-receiver copies), and verdicts deliver in BULK per client
+        (``on_branch_verdicts(ctxs, oks)``): a wave's N^2 echoes cost
+        one call per instance, not one closure each."""
         self.branch_items += len(items)
-        verdict_of: Dict[Tuple, bool] = {}
+        verdicts: List[bool] = [False] * len(slots)
         if self.dedup:
             memo = self._branch_memo.map
             fresh: List[Tuple] = []
-            for item in items:
-                key = (item[0], item[1], item[2], item[3])
-                if key not in verdict_of:
-                    hit = memo.get(key)
-                    if hit is None:
-                        fresh.append(
-                            (item[0], item[1], item[2], item[3], key)
-                        )
-                        verdict_of[key] = False  # filled below
-                    else:
-                        verdict_of[key] = hit
+            for si, (root, leaf, branch, index) in enumerate(slots):
+                key = (root, leaf, branch, index)
+                hit = memo.get(key)
+                if hit is None:
+                    fresh.append((root, leaf, branch, index, si, key))
+                else:
+                    verdicts[si] = hit
             if fresh:
+                put = self._branch_memo.put
 
-                def fill(it, good, local=verdict_of):
+                def fill(it, good, local=verdicts, put=put):
                     local[it[4]] = good
-                    self._branch_memo.put(it[4], good)
+                    put(it[5], good)
 
                 self._verify_branch_groups(fresh, fill)
-        else:
+        elif slots:
             self._verify_branch_groups(
-                [item[:4] + (item[:4],) for item in items],
-                lambda it, good: verdict_of.__setitem__(it[4], good),
+                [
+                    slot + (si, None)
+                    for si, slot in enumerate(slots)
+                ],
+                lambda it, good: verdicts.__setitem__(it[4], good),
             )
         # bulk delivery, preserving per-client arrival order
         by_client: Dict[int, Tuple[object, List, List]] = {}
-        for item in items:
-            client, ctx = item[4], item[5]
+        for client, ctx, slot in items:
             ent = by_client.get(id(client))
             if ent is None:
                 ent = (client, [], [])
                 by_client[id(client)] = ent
             ent[1].append(ctx)
-            ent[2].append(
-                verdict_of[(item[0], item[1], item[2], item[3])]
-            )
+            ent[2].append(verdicts[slot])
         for client, ctxs, oks in by_client.values():
             client.on_branch_verdicts(ctxs, oks)
 
@@ -312,7 +454,7 @@ class CryptoHub:
     ) -> None:
         groups: Dict[Tuple[int, int], List[Tuple]] = {}
         for item in items:
-            _root, leaf, branch, _index, _cb = item
+            _root, leaf, branch = item[0], item[1], item[2]
             groups.setdefault((len(branch), len(leaf)), []).append(item)
         for group in groups.values():
             self.dispatches += 1
@@ -343,9 +485,12 @@ class CryptoHub:
 
     def _run_decodes(self, items: List[Tuple]) -> None:
         """Interpolate + re-encode + root recheck (docs/RBC-EN.md:37-39)
-        for many instances at once, grouped by shard length — ONE
-        fused dispatch per group on the 'tpu' backend
-        (BatchCrypto.decode_recheck_batch)."""
+        for many instances at once, grouped by shard shape — ONE fused
+        dispatch per group on the 'tpu' backend
+        (BatchCrypto.decode_recheck_batch).  Item shape:
+        (root, idxs, [shard bytes], cb); the hub builds each unique
+        matrix exactly once (dedup key (root, idxs): decode inputs are
+        branch-verified, see class docstring)."""
         self.decode_items += len(items)
         if self.dedup:
             memo = self._decode_memo.map
@@ -353,13 +498,13 @@ class CryptoHub:
             _miss = object()
             fresh: List[Tuple] = []
             keys = []
-            for item in items:
-                key = (item[2], item[0], item[1].tobytes())
+            for root, idxs, shards, _cb in items:
+                key = (root, idxs)
                 keys.append(key)
                 if key not in local:
                     hit = memo.get(key, _miss)
                     if hit is _miss:
-                        fresh.append((item[0], item[1], item[2], key))
+                        fresh.append((root, idxs, shards, key))
                         local[key] = None  # filled by decode below
                     else:
                         local[key] = hit
@@ -382,19 +527,24 @@ class CryptoHub:
     def _decode_groups(self, items: List[Tuple], deliver: Callable) -> None:
         groups: Dict[Tuple[int, int], List[Tuple]] = {}
         for item in items:
-            idxs, shards = item[0], item[1]
-            groups.setdefault((shards.shape[0], shards.shape[1]), []).append(
-                item
-            )
+            idxs, shards = item[1], item[2]
+            groups.setdefault((len(idxs), len(shards[0])), []).append(item)
         for group in groups.values():
-            idx_arr = np.stack([np.asarray(it[0]) for it in group])
-            shard_arr = np.stack([it[1] for it in group])
+            k, length = len(group[0][1]), len(group[0][2][0])
+            idx_arr = np.asarray([it[1] for it in group])
+            # one join+frombuffer for the whole group's matrices (the
+            # per-client np.stack of per-shard frombuffers was ~3% of
+            # an N=64 epoch)
+            shard_arr = np.frombuffer(
+                b"".join(s for it in group for s in it[2]),
+                dtype=np.uint8,
+            ).reshape(len(group), k, length)
             data, roots, dispatches = self.crypto.decode_recheck_batch(
                 idx_arr, shard_arr
             )
             self.dispatches += dispatches
             for it, row, root in zip(group, data, roots):
-                deliver(it, row if root.tobytes() == it[2] else None)
+                deliver(it, row if root.tobytes() == it[0] else None)
 
     def _run_shares(self, items: List[Tuple]) -> None:
         """ALL pooled threshold shares (TPKE decryption + BBA coins,
@@ -423,8 +573,8 @@ class CryptoHub:
         """Each distinct (pub, base, context, share) CP check verifies
         once; verdicts fan out to every client that pooled a copy."""
         memo = self._share_memo.map
-        # local verdict view for THIS call: immune to a memo clear-on-
-        # overflow racing between put and the fan-out read below
+        # local verdict view for THIS call: immune to memo eviction
+        # racing between put and the fan-out read below
         local: Dict[Tuple, bool] = {}
         # (token, base, context) -> [(key, share)] of fresh checks
         fresh: Dict[Tuple, List[Tuple]] = {}
@@ -479,4 +629,4 @@ class CryptoHub:
         }
 
 
-__all__ = ["CryptoHub"]
+__all__ = ["CryptoHub", "HubWave"]

@@ -34,8 +34,6 @@ from __future__ import annotations
 
 from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
 
-import numpy as np
-
 from cleisthenes_tpu.config import Config
 from cleisthenes_tpu.ops.backend import BatchCrypto
 from cleisthenes_tpu.ops.payload import join_payload, split_payload
@@ -253,8 +251,12 @@ class RBC:
 
     def _check_proof(self, payload: RbcPayload) -> bool:
         """Full inline verification (VAL only — ECHO proofs batch
-        through the hub)."""
-        return self._precheck(payload) and self.crypto.merkle.verify_branch(
+        through the hub).  The one sanctioned direct crypto call in
+        protocol/: a single proposer branch per instance, and the ECHO
+        reply cannot wait for a wave."""
+        if not self._precheck(payload):
+            return False
+        return self.crypto.merkle.verify_branch(  # staticcheck: allow[DET003] inline VAL check
             payload.root_hash,
             payload.shard,
             list(payload.branch),
@@ -446,25 +448,30 @@ class RBC:
 
     # -- hub client protocol (protocol.hub.CryptoHub) ----------------------
 
-    def collect_crypto_work(self, branches, decodes, shares) -> None:
+    def drain_pending(self, wave) -> None:
+        """Move pending crypto work into the wave's typed columns
+        (protocol.hub.HubWave): every parked ECHO proof as a branch
+        item, every staged decode whose matrix is complete as a decode
+        item (shard BYTES in index order — the hub builds each unique
+        matrix once instead of one np.stack per client)."""
         if self.delivered or not (self._pending_echo or self._decode_req):
-            return  # fast path: the hub polls every client per flush
+            return  # fast path: the hub may drain a client twice/round
         # pending ECHO proofs -> batched branch verification (pools
         # pop wholesale: an emptied root must not linger as an empty
         # dict and defeat the fast path above)
-        for root in list(self._pending_echo):
-            items = self._pending_echo.pop(root)
-            for sender, (branch, shard, sidx) in items.items():
-                branches.append(
-                    (
+        if self._pending_echo:
+            add = wave.add_branch
+            for root in list(self._pending_echo):
+                items = self._pending_echo.pop(root)
+                for sender, (branch, shard, sidx) in items.items():
+                    add(
+                        self,
                         root,
                         shard,
                         branch,
                         sidx,
-                        self,
                         (root, sender, shard, sidx),
                     )
-                )
         # staged decode requests with enough verified shards; sorted:
         # _decode_req is a set of 32-byte roots, and its hash order
         # (PYTHONHASHSEED-dependent) would otherwise decide decode
@@ -478,15 +485,23 @@ class RBC:
                 continue  # stays staged until shards verify
             self._decode_req.discard(root)
             idxs = tuple(sorted(shards_map)[: self.k])
-            mat = np.stack(
-                [np.frombuffer(shards_map[i], dtype=np.uint8) for i in idxs]
+            wave.add_decode(
+                root,
+                idxs,
+                [shards_map[i] for i in idxs],
+                self._make_decode_cb(root),
             )
-            decodes.append((idxs, mat, root, self._make_decode_cb(root)))
 
     def on_branch_verdicts(self, ctxs, oks) -> None:
         """Bulk ECHO-branch verdicts from the hub (one call per flush
         instead of a per-echo closure — at N=64 the closures alone
-        were ~1.8 s of an epoch).  ctx = (root, sender, shard, sidx)."""
+        were ~1.8 s of an epoch).  ctx = (root, sender, shard, sidx).
+
+        A root crossing its N-f echo quorum here stages its decode
+        request IMMEDIATELY (not in after_crypto_flush): the hub
+        re-drains verdict-marked clients before running the round's
+        decode column, so the decode rides THIS wave's single decode
+        dispatch instead of a follow-on round's."""
         if self.delivered:
             return
         shard_len = self._shard_len
@@ -506,10 +521,19 @@ class RBC:
             echo_senders.setdefault(root, set()).add(sender)
             shards.setdefault(root, {})[sidx] = shard
             re_mark = True
+        if not re_mark:
+            return
+        # stage any echo-quorum decode now (same guards as
+        # after_crypto_flush; _request_decode dedups staged roots)
+        if self._ready_root is None:
+            quorum = self.n - self.f
+            for root, senders in echo_senders.items():
+                if len(senders) >= quorum and root not in self._bad_roots:
+                    self._request_decode(root)
         # a staged decode may just have reached k shards — stay on
-        # the hub's dirty list for its next round (no decode
-        # staged -> nothing new to collect, skip the re-mark)
-        if re_mark and self._decode_req:
+        # the hub's dirty list so this wave round (or the next)
+        # collects it (no decode staged -> nothing new to offer)
+        if self._decode_req:
             self.hub.mark_dirty(self)
 
     def _make_decode_cb(self, root: bytes):

@@ -48,6 +48,11 @@ the true geometric distribution, not a stub.
 # only fills the returned stats dict (wall-clock observability); no
 # timing value ever feeds protocol state, wire bytes, or the commit rule
 
+# staticcheck: allow-file[DET003] the lockstep plane IS its own columnar
+# batch layer: every epoch's crypto already runs as a handful of wide
+# dispatches with no hub in the loop, which is exactly the discipline
+# DET003 protects on the async path
+
 from __future__ import annotations
 
 import collections

@@ -15,6 +15,15 @@ of numpy operations, and only threshold CROSSINGS (f+1 relay, 2f+1
 bin_values growth, n-f AUX quorum — a constant number per instance
 per round) fall back to the per-instance protocol logic in BBA.
 
+Array layouts put the wave's axis LAST: receipt state is indexed
+``seen[sender, value, instance]`` so one frame's update touches a
+contiguous row, and activity + round fold into ONE ``round_state``
+vector (the instance's current round, or a huge sentinel once halted
+— every later vote for it compares stale and drops in the same
+vectorized filter).  At n=64 the fixed per-numpy-op cost dominates
+this function, so the layout exists to minimize op COUNT (measured
+~40% off batch_vote at N=64), not element traffic.
+
 Consistency contract: the bank is the SINGLE source of truth for
 BVAL/AUX receipt state of each instance's current round.  BBA's
 scalar path (off-round replays, unit tests, non-columnar transports)
@@ -40,6 +49,11 @@ import numpy as np
 # handful of tuples per wave).
 _PROP_CACHE_CAP = 4096
 
+# round_state sentinel for halted instances: any real vote round
+# (< bba.MAX_ROUNDS = 1000) compares STALE against it, so halted rows
+# drop in the same vectorized stale filter as old-round votes
+_HALTED = 1 << 62
+
 
 class VoteBank:
     """Struct-of-arrays vote state for up to ``n_inst`` BBA instances
@@ -63,18 +77,21 @@ class VoteBank:
         insts = self.members if inst_ids is None else list(inst_ids)
         self.iidx: Dict[str, int] = {p: i for i, p in enumerate(insts)}
         n_inst, ns = len(insts), len(self.members)
-        self.bval_seen = np.zeros((n_inst, ns, 2), dtype=bool)
-        self.bval_cnt = np.zeros((n_inst, 2), dtype=np.int32)
-        self.aux_seen = np.zeros((n_inst, ns), dtype=bool)
-        self.aux_cnt = np.zeros((n_inst, 2), dtype=np.int32)
+        # [sender, value, instance]: one frame's dedup probe is a
+        # contiguous-row fancy index
+        self.bval_seen = np.zeros((ns, 2, n_inst), dtype=bool)
+        self.bval_cnt = np.zeros((2, n_inst), dtype=np.int32)
+        self.aux_seen = np.zeros((ns, n_inst), dtype=bool)
+        self.aux_cnt = np.zeros((2, n_inst), dtype=np.int32)
         # bin_flags[i, v]: v in instance i's current-round bin_values
+        # (instance-major: BBA reads bin_flags[self.index, vi] scalar)
         self.bin_flags = np.zeros((n_inst, 2), dtype=bool)
         # edge-trigger memory: on_aux_quorum fires once per row (the
         # post-quorum AUX stream at N=64 was ~220k redundant probes
         # per epoch); bin_values growth re-probes via BBA directly
         self.aux_fired = np.zeros(n_inst, dtype=bool)
-        self.row_round = np.zeros(n_inst, dtype=np.int64)
-        self.active = np.ones(n_inst, dtype=bool)
+        # current round per instance; _HALTED once deactivated
+        self.round_state = np.zeros(n_inst, dtype=np.int64)
         self.bbas: List[object] = [None] * n_inst
         self._prop_cache: "Dict[tuple, Tuple[np.ndarray, bool]]" = {}
 
@@ -85,39 +102,42 @@ class VoteBank:
 
     def reset_row(self, index: int, rnd: int) -> None:
         """New round for one instance: receipt state starts empty."""
-        self.bval_seen[index] = False
-        self.bval_cnt[index] = 0
-        self.aux_seen[index] = False
-        self.aux_cnt[index] = 0
+        self.bval_seen[:, :, index] = False
+        self.bval_cnt[:, index] = 0
+        self.aux_seen[:, index] = False
+        self.aux_cnt[:, index] = 0
         self.bin_flags[index] = False
         self.aux_fired[index] = False
-        self.row_round[index] = rnd
+        self.round_state[index] = rnd
 
     def deactivate(self, index: int) -> None:
-        """Halted instance: every later delivery drops vectorized."""
-        self.active[index] = False
+        """Halted instance: every later delivery drops vectorized (the
+        sentinel makes any real round number compare stale)."""
+        self.round_state[index] = _HALTED
 
     # -- scalar write-through (BBA's non-columnar path) --------------------
 
     def bval_add(self, index: int, sender_idx: int, value: bool):
         """Record one BVAL; returns the new count, or None if duplicate."""
         vi = 1 if value else 0
-        if self.bval_seen[index, sender_idx, vi]:
+        row = self.bval_seen[sender_idx, vi]
+        if row[index]:
             if self.metrics is not None:
                 self.metrics.dedup_absorbed.inc()
             return None
-        self.bval_seen[index, sender_idx, vi] = True
-        self.bval_cnt[index, vi] += 1
-        return int(self.bval_cnt[index, vi])
+        row[index] = True
+        self.bval_cnt[vi, index] += 1
+        return int(self.bval_cnt[vi, index])
 
     def aux_add(self, index: int, sender_idx: int, value: bool) -> bool:
         """Record one AUX; returns False on duplicate sender."""
-        if self.aux_seen[index, sender_idx]:
+        row = self.aux_seen[sender_idx]
+        if row[index]:
             if self.metrics is not None:
                 self.metrics.dedup_absorbed.inc()
             return False
-        self.aux_seen[index, sender_idx] = True
-        self.aux_cnt[index, 1 if value else 0] += 1
+        row[index] = True
+        self.aux_cnt[1 if value else 0, index] += 1
         return True
 
     def set_bin(self, index: int, value: bool) -> None:
@@ -128,32 +148,34 @@ class VoteBank:
         basis, docs/BBA-EN.md:140-156) — O(1) from the counters."""
         g = 0
         if self.bin_flags[index, 1]:
-            g += int(self.aux_cnt[index, 1])
+            g += int(self.aux_cnt[1, index])
         if self.bin_flags[index, 0]:
-            g += int(self.aux_cnt[index, 0])
+            g += int(self.aux_cnt[0, index])
         return g
 
     def aux_vals(self, index: int) -> set:
         """Distinct received-AUX values that are in bin_values."""
         vals = set()
-        if self.bin_flags[index, 1] and self.aux_cnt[index, 1] > 0:
+        if self.bin_flags[index, 1] and self.aux_cnt[1, index] > 0:
             vals.add(True)
-        if self.bin_flags[index, 0] and self.aux_cnt[index, 0] > 0:
+        if self.bin_flags[index, 0] and self.aux_cnt[0, index] > 0:
             vals.add(False)
         return vals
 
     # -- columnar delivery (ACS batch path) --------------------------------
 
     def _indices(self, proposers: tuple) -> "Tuple[np.ndarray, bool]":
-        """(index array, has_duplicates) — computed once per distinct
-        proposers tuple: honest batches never repeat an instance, so
-        batch_vote's dedup (np.unique, ~30% of its cost) runs only
-        for flagged Byzantine payloads."""
+        """(known-instance index array, has_duplicates) — computed once
+        per distinct proposers tuple: unknown proposers drop at cache
+        build (membership is fixed), and honest batches never repeat
+        an instance, so batch_vote's dedup (np.unique, ~30% of its
+        cost) runs only for flagged Byzantine payloads."""
         ent = self._prop_cache.get(proposers)
         if ent is None:
             iidx = self.iidx
             arr = np.asarray(
-                [iidx.get(p, -1) for p in proposers], dtype=np.int64
+                [iidx[p] for p in proposers if p in iidx],
+                dtype=np.int64,
             )
             dups = len(set(proposers)) != len(proposers)
             if len(self._prop_cache) >= _PROP_CACHE_CAP:
@@ -172,73 +194,90 @@ class VoteBank:
     ) -> None:
         """One sender's vote fanned across ``proposers``: vectorized
         dedup + counting for in-round instances; off-round instances
-        fall back to BBA's scalar gate (parking / stale-drop)."""
+        fall back to BBA's scalar gate (parking / stale-drop).  The
+        hot path (every instance in-round, vote fresh — the honest
+        wave shape) runs a minimal op count; `.all()`/`.any()` probes
+        divert the rare mixed cases onto slower branches."""
         si = self.sidx.get(sender)
         if si is None:
             return
         pi, dups = self._indices(proposers)
-        pi = pi[pi >= 0]
         if pi.size == 0:
             return
-        live = self.active[pi]
-        pi = pi[live]
-        rounds = self.row_round[pi]
-        on = rounds == rnd
-        # stale (rnd < current round) drops vectorized — same as
-        # _gated's stale return, without N python calls per frame
-        fut = pi[rounds < rnd]
-        # future rounds: scalar fallback (rare — round-horizon
-        # parking; replay order is preserved by BBA._future)
-        if fut.size:
-            from cleisthenes_tpu.transport.message import BbaType
+        rs = self.round_state[pi]
+        on = rs == rnd
+        if on.all():
+            sel = pi
+        else:
+            # future rounds: scalar fallback (rare — round-horizon
+            # parking; replay order is preserved by BBA._future).
+            # Stale (rnd < current round, or halted at the sentinel)
+            # drops vectorized — same as _gated's stale return,
+            # without N python calls per frame.
+            fut = pi[rs < rnd]
+            if fut.size:
+                from cleisthenes_tpu.transport.message import BbaType
 
-            t = BbaType.BVAL if is_bval else BbaType.AUX
-            for i in fut:
-                bba = self.bbas[i]
-                if bba is not None:
-                    bba.handle_vote(sender, t, rnd, value)
-        sel = pi[on]
-        if sel.size == 0:
-            return
+                t = BbaType.BVAL if is_bval else BbaType.AUX
+                for i in fut:
+                    bba = self.bbas[i]
+                    if bba is not None:
+                        bba.handle_vote(sender, t, rnd, value)
+            sel = pi[on]
+            if sel.size == 0:
+                return
         if dups:  # only Byzantine batches repeat instances
             sel = np.unique(sel)
         vi = 1 if value else 0
+        metrics = self.metrics
         if is_bval:
-            new = sel[~self.bval_seen[sel, si, vi]]
-            if self.metrics is not None and new.size < sel.size:
-                self.metrics.dedup_absorbed.inc(
-                    int(sel.size - new.size)
-                )
-            if new.size == 0:
-                return
-            self.bval_seen[new, si, vi] = True
-            self.bval_cnt[new, vi] += 1
-            cnts = self.bval_cnt[new, vi]
+            row = self.bval_seen[si, vi]
+            seen = row[sel]
+            if seen.any():
+                new = sel[~seen]
+                if metrics is not None:
+                    metrics.dedup_absorbed.inc(int(sel.size - new.size))
+                if new.size == 0:
+                    return
+            else:
+                new = sel
+            row[new] = True
+            cnt = self.bval_cnt[vi]
+            cnt[new] += 1
+            cnts = cnt[new]
             relay = new[cnts == self.f + 1]
             grow = new[cnts == 2 * self.f + 1]
+            bbas = self.bbas
+            # f+1 same bval -> relay once; 2f+1 -> bin_values union
+            # (docs/BBA-EN.md:47-58)
             for i in relay:
-                bba = self.bbas[i]
+                bba = bbas[i]
                 if bba is not None and not bba.halted:
                     bba.on_bval_relay(value)
             for i in grow:
-                bba = self.bbas[i]
+                bba = bbas[i]
                 if bba is not None and not bba.halted:
                     bba.on_bval_bin(value)
         else:
-            new = sel[~self.aux_seen[sel, si]]
-            if self.metrics is not None and new.size < sel.size:
-                self.metrics.dedup_absorbed.inc(
-                    int(sel.size - new.size)
-                )
-            if new.size == 0:
-                return
-            self.aux_seen[new, si] = True
-            self.aux_cnt[new, vi] += 1
+            row = self.aux_seen[si]
+            seen = row[sel]
+            if seen.any():
+                new = sel[~seen]
+                if metrics is not None:
+                    metrics.dedup_absorbed.inc(int(sel.size - new.size))
+                if new.size == 0:
+                    return
+            else:
+                new = sel
+            row[new] = True
+            cnt = self.aux_cnt[vi]
+            cnt[new] += 1
             # quorum trigger: good >= n-f (>=, not ==: bin_values
             # growth also moves `good`, so equality could be skipped;
             # post-quorum extras are cheap idempotent no-ops in BBA)
-            good = self.aux_cnt[new, 1] * self.bin_flags[new, 1] + (
-                self.aux_cnt[new, 0] * self.bin_flags[new, 0]
+            binf = self.bin_flags[new]
+            good = self.aux_cnt[1][new] * binf[:, 1] + (
+                self.aux_cnt[0][new] * binf[:, 0]
             )
             n = len(self.members)
             trig = new[(good >= n - self.f) & ~self.aux_fired[new]]
@@ -249,8 +288,9 @@ class VoteBank:
             # coin reveal and bin growth, which have their own
             # triggers); vals are read at advance time either way
             self.aux_fired[trig] = True
+            bbas = self.bbas
             for i in trig:
-                bba = self.bbas[i]
+                bba = bbas[i]
                 if bba is not None and not bba.halted:
                     bba.on_aux_quorum()
 

@@ -93,6 +93,35 @@ def wire_idle_hooks(handler):
 # ---------------------------------------------------------------------------
 
 
+def _hmac_sha256_fn(key: bytes) -> Callable[[bytes], bytes]:
+    """Precomputed HMAC-SHA256 for one pair key (RFC 2104).
+
+    ``hmac.new`` re-runs the key schedule — two full SHA-256 block
+    compressions over the padded key — on EVERY call; at N=64 that is
+    ~280k schedules per epoch (one per signed + one per verified
+    frame) for a roster of 63 fixed keys.  Here the inner/outer pad
+    contexts initialize once per pair key and each MAC is two context
+    copies + updates.  Byte-for-byte identical output to
+    ``hmac.new(key, msg, hashlib.sha256).digest()`` (asserted by
+    tests/test_transport.py); comparisons still go through
+    ``hmac.compare_digest``.
+    """
+    if len(key) > 64:  # SHA-256 block size
+        key = hashlib.sha256(key).digest()
+    key = key.ljust(64, b"\x00")
+    inner = hashlib.sha256(bytes(b ^ 0x36 for b in key))
+    outer = hashlib.sha256(bytes(b ^ 0x5C for b in key))
+
+    def mac(msg: bytes, _inner=inner, _outer=outer) -> bytes:
+        h = _inner.copy()
+        h.update(msg)
+        o = _outer.copy()
+        o.update(h.digest())
+        return o.digest()
+
+    return mac
+
+
 class Authenticator(abc.ABC):
     """Signs and verifies envelope MACs.
 
@@ -170,6 +199,12 @@ class HmacAuthenticator(Authenticator):
     def __init__(self, self_id: str, peer_keys: "Dict[str, bytes]"):
         self._self_id = self_id
         self._peer_keys = dict(peer_keys)
+        # per-peer precomputed HMAC key schedules (the roster is
+        # fixed; see _hmac_sha256_fn)
+        self._macs: "Dict[str, Callable[[bytes], bytes]]" = {
+            peer: _hmac_sha256_fn(key)
+            for peer, key in self._peer_keys.items()
+        }
 
     @staticmethod
     def pair_key(master_secret: bytes, a: str, b: str) -> bytes:
@@ -215,23 +250,23 @@ class HmacAuthenticator(Authenticator):
             raise ValueError(
                 "pairwise MAC needs the receiver id at sign time"
             )
-        key = self._key_with(receiver_id)
-        if key is None:
+        mac_fn = self._macs.get(receiver_id)
+        if mac_fn is None:
             raise ValueError(f"no pair key with {receiver_id!r}")
-        mac = hmac.new(key, signing_bytes(msg), hashlib.sha256).digest()
         return Message(
             sender_id=msg.sender_id,
             timestamp=msg.timestamp,
             payload=msg.payload,
-            signature=mac,
+            signature=mac_fn(signing_bytes(msg)),
         )
 
     def verify(self, msg: Message) -> bool:
-        key = self._key_with(msg.sender_id)
-        if key is None:  # not a roster member we share a key with
+        mac_fn = self._macs.get(msg.sender_id)
+        if mac_fn is None:  # not a roster member we share a key with
             return False
-        want = hmac.new(key, signing_bytes(msg), hashlib.sha256).digest()
-        return hmac.compare_digest(want, msg.signature)
+        return hmac.compare_digest(
+            mac_fn(signing_bytes(msg)), msg.signature
+        )
 
     def verify_wire(self, msg: Message, signing_prefix: bytes) -> bool:
         """MAC the frame's signing prefix directly.
@@ -248,11 +283,10 @@ class HmacAuthenticator(Authenticator):
         canonical, so honest peers never emit such frames, and a
         Byzantine key holder gains nothing it couldn't send anyway
         (no component deduplicates or compares raw frame bytes)."""
-        key = self._key_with(msg.sender_id)
-        if key is None:
+        mac_fn = self._macs.get(msg.sender_id)
+        if mac_fn is None:
             return False
-        want = hmac.new(key, signing_prefix, hashlib.sha256).digest()
-        return hmac.compare_digest(want, msg.signature)
+        return hmac.compare_digest(mac_fn(signing_prefix), msg.signature)
 
     def sign_wire_many(self, msg: Message, receiver_ids) -> "Dict[str, bytes]":
         """Broadcast fast path: one payload encode, one MAC per peer."""
@@ -262,14 +296,13 @@ class HmacAuthenticator(Authenticator):
                 f"holds the keys of {self._self_id!r}"
             )
         sb = signing_bytes(msg)
+        macs = self._macs
         out: Dict[str, bytes] = {}
         for rid in receiver_ids:
-            key = self._key_with(rid)
-            if key is None:
+            mac_fn = macs.get(rid)
+            if mac_fn is None:
                 raise ValueError(f"no pair key with {rid!r}")
-            out[rid] = attach_signature(
-                sb, hmac.new(key, sb, hashlib.sha256).digest()
-            )
+            out[rid] = attach_signature(sb, mac_fn(sb))
         return out
 
 

@@ -187,7 +187,14 @@ class CoalescingBroadcaster:
     def __init__(self, inner, member_ids: Sequence[str], trace=None) -> None:
         self._inner = inner
         self._members: List[str] = sorted(member_ids)
-        self._buffers: Dict[str, List[Payload]] = {
+        # Broadcast payloads buffer ONCE on a shared list (a wave is
+        # ~50k broadcasts at N=64; appending each to N per-receiver
+        # buffers was ~1 s of epoch wall).  send_to payloads park per
+        # receiver as (anchor, payload), anchor = the shared-list
+        # position they arrived at, so the flush can reconstruct each
+        # receiver's exact arrival-order interleaving.
+        self._shared: List[Payload] = []
+        self._extras: Dict[str, List[tuple]] = {
             m: [] for m in self._members
         }
         self._dirty = False
@@ -200,17 +207,16 @@ class CoalescingBroadcaster:
         self.trace = trace
 
     def broadcast(self, payload: Payload) -> None:
-        for m in self._members:
-            self._buffers[m].append(payload)
+        self._shared.append(payload)
         self.payloads_buffered += len(self._members)
         self._dirty = True
 
     def send_to(self, member_id: str, payload: Payload) -> None:
-        buf = self._buffers.get(member_id)
+        buf = self._extras.get(member_id)
         if buf is None:  # not a roster member: pass through untouched
             self._inner.send_to(member_id, payload)
             return
-        buf.append(payload)
+        buf.append((len(self._shared), payload))
         self.payloads_buffered += 1
         self._dirty = True
         self._broadcast_only = False
@@ -235,7 +241,9 @@ class CoalescingBroadcaster:
             return
         t0 = tr.now()
         bundles0 = self.bundles_flushed
-        payloads = sum(len(b) for b in self._buffers.values())
+        payloads = len(self._shared) * len(self._members) + sum(
+            len(b) for b in self._extras.values()
+        )
         try:
             self._flush_dirty()
         finally:
@@ -247,37 +255,65 @@ class CoalescingBroadcaster:
                 payloads=payloads,
             )
 
+    def _merged(self, shared: List[Payload], extras: List[tuple]):
+        """One receiver's arrival-order payload list: extras spliced
+        back at their anchors (anchors are nondecreasing)."""
+        out: List[Payload] = []
+        i = 0
+        for anchor, p in extras:
+            if i < anchor:
+                out.extend(shared[i:anchor])
+                i = anchor
+            out.append(p)
+        out.extend(shared[i:])
+        return out
+
     def _flush_dirty(self) -> None:
         self._dirty = False
         broadcast_only = self._broadcast_only
         self._broadcast_only = True
         if broadcast_only:
-            # identical buffers by construction: one envelope for all
-            first = self._buffers[self._members[0]]
-            if first:
+            # every receiver's bundle is the shared list by
+            # construction: one fold, one envelope for all
+            shared = self._shared
+            if shared:
                 try:
-                    self._inner.broadcast(self._fold(first))
+                    self._inner.broadcast(self._fold(shared))
                 except Exception:
                     self._dirty = True
                     self._broadcast_only = broadcast_only
                     raise
-                for m in self._members:
-                    self._buffers[m] = []
+                self._shared = []
                 self.bundles_flushed += len(self._members)
             return
+        # mixed wave (rare: VAL fan-outs, CATCHUP serves): materialize
+        # every receiver's merged view FIRST, then post — a transport
+        # failure mid-loop must leave unsent members' payloads
+        # buffered for the retry, already merged (anchor 0: they
+        # precede anything buffered later)
+        shared, self._shared = self._shared, []
+        merged: Dict[str, List[Payload]] = {}
         for m in self._members:
-            buf = self._buffers[m]
+            extras = self._extras[m]
+            if extras:
+                self._extras[m] = []
+                merged[m] = self._merged(shared, extras)
+            elif shared:
+                merged[m] = shared  # never mutated below
+        for mi, m in enumerate(self._members):
+            buf = merged.get(m)
             if not buf:
                 continue
             try:
                 self._inner.send_to(m, self._fold(buf))
             except Exception:
-                # this member's (and any later members') payloads stay
-                # buffered for the retry
+                for m2 in self._members[mi:]:
+                    left = merged.get(m2)
+                    if left:
+                        self._extras[m2] = [(0, p) for p in left]
                 self._dirty = True
                 self._broadcast_only = False
                 raise
-            self._buffers[m] = []
             self.bundles_flushed += 1
 
 

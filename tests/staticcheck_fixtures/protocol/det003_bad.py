@@ -1,0 +1,30 @@
+"""DET003 known-bad: direct BatchCrypto verify/decode dispatch from
+protocol/ code outside hub.py — every call here bypasses the hub's
+columnar seam and regresses the wave back to scalar dispatch."""
+
+from cleisthenes_tpu.ops.tpke import verify_share_groups
+
+
+class LeakyClient:
+    def __init__(self, crypto, pub):
+        self.crypto = crypto
+        self.pub = pub
+        self._pending = []
+
+    def handle_echo(self, root, leaf, branch, index):
+        # scalar per-message Merkle check instead of staging the proof
+        return self.crypto.merkle.verify_branch(root, leaf, branch, index)  # BAD:DET003
+
+    def handle_echo_wavefront(self, items):
+        # batched, but still a direct dispatch — the hub owns this call
+        return self.crypto.merkle.verify_batch(items)  # BAD:DET003
+
+    def try_decode(self, idxs, shards):
+        data, roots, _n = self.crypto.decode_recheck_batch(idxs, shards)  # BAD:DET003
+        return data, roots
+
+    def check_shares(self, base, context, shares):
+        # from-imported ops function resolves through the alias map
+        return verify_share_groups(  # BAD:DET003
+            [(self.pub, base, context, shares)]
+        )

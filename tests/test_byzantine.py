@@ -171,13 +171,15 @@ def test_byzantine_invalid_dec_share_falls_back_to_verified_path():
     cfg, net, nodes = make_hb_network(4, batch_size=8)  # FIFO scheduler
     bad = "node0"  # sorts first: its junk share lands in the subset
     hb_bad = nodes[bad]
-    real_dec_share = hb_bad.tpke.dec_share
+    real_batch = hb_bad.tpke.dec_share_batch
 
-    def junk_dec_share(share, ct):
-        good = real_dec_share(share, ct)
-        return DhShare(index=good.index, d=12345, e=good.e, z=good.z)
+    def junk_dec_share_batch(share, cts):
+        return [
+            DhShare(index=good.index, d=12345, e=good.e, z=good.z)
+            for good in real_batch(share, cts)
+        ]
 
-    hb_bad.tpke.dec_share = junk_dec_share
+    hb_bad.tpke.dec_share_batch = junk_dec_share_batch
     push_txs(nodes, 12)
     run_epochs(net, nodes)
     assert_identical_batches(nodes)
@@ -422,15 +424,17 @@ def test_byzantine_duplicate_index_dec_share_does_not_stall():
     cfg, net, nodes = make_hb_network(4, batch_size=8)  # FIFO scheduler
     bad = "node0"  # sorts first: its share lands early in every pool
     hb_bad = nodes[bad]
-    real_dec_share = hb_bad.tpke.dec_share
+    real_batch = hb_bad.tpke.dec_share_batch
 
-    def replayed_index_share(share, ct):
-        good = real_dec_share(share, ct)
+    def replayed_index_batch(share, cts):
         # claim another sender's index: a valid-looking duplicate that
         # contributes no distinct interpolation point
-        return DhShare(index=2, d=good.d, e=good.e, z=good.z)
+        return [
+            DhShare(index=2, d=good.d, e=good.e, z=good.z)
+            for good in real_batch(share, cts)
+        ]
 
-    hb_bad.tpke.dec_share = replayed_index_share
+    hb_bad.tpke.dec_share_batch = replayed_index_batch
     push_txs(nodes, 12)
     run_epochs(net, nodes)
     assert_identical_batches(nodes)

@@ -14,7 +14,7 @@ from cleisthenes_tpu.config import Config
 from cleisthenes_tpu.ops import tpke
 from cleisthenes_tpu.ops.backend import BatchCrypto
 from cleisthenes_tpu.ops.coin import CommonCoin
-from cleisthenes_tpu.protocol.hub import CryptoHub
+from cleisthenes_tpu.protocol.hub import CryptoHub, HubWave, _Memo
 
 
 class TestVerifyShareGroups:
@@ -100,23 +100,16 @@ class TestHubBatching:
                     results[key] = ok
 
         sink = Sink()
-        items = []
+        wave = HubWave(hub.dedup)
         for t_i, t in enumerate(trees):
             for j in range(8):
                 leaf = shards[t_i, j].tobytes()
                 if t_i == 1 and j == 3:
                     leaf = b"\xff" + leaf[1:]  # corrupt
-                items.append(
-                    (
-                        t.root,
-                        leaf,
-                        tuple(t.branch(j)),
-                        j,
-                        sink,
-                        (t_i, j),
-                    )
+                wave.add_branch(
+                    sink, t.root, leaf, tuple(t.branch(j)), j, (t_i, j)
                 )
-        hub._run_branches(items)
+        hub._run_branches(*wave.take_branches())
         for t_i, t in enumerate(trees):
             for j in range(8):
                 single = crypto.merkle.verify_branch(
@@ -157,6 +150,57 @@ class TestHubBatching:
             # ...in batched dispatches, not one per item
             assert st["dispatches"] < st["branch_items"] + st["share_items"]
             assert st["dispatches"] <= 120, st
+            # every flush that executed work logged its column width,
+            # and the widths account for every item the hub ran
+            assert hb.hub.wave_widths
+            assert sum(hb.hub.wave_widths) == (
+                st["branch_items"] + st["decode_items"] + st["share_items"]
+            )
+
+
+class TestMemoFifo:
+    def test_fifo_evicts_oldest_insertion_only(self):
+        m = _Memo(4)
+        for i in range(4):
+            m.put(i, i)
+        m.put(4, 4)  # at cap: evicts key 0, keeps everything newer
+        assert 0 not in m.map
+        assert list(m.map) == [1, 2, 3, 4]
+        m.put(2, 22)  # existing key: value refresh, no eviction
+        assert m.map[2] == 22 and len(m.map) == 4
+        m.put(5, 5)  # next eviction is the NEXT-oldest (1), not all
+        assert list(m.map) == [2, 3, 4, 5]
+
+
+class TestHubWaveIdDedup:
+    def test_receiver_copies_collapse_to_one_slot(self):
+        """In dedup mode, N clients offering the same decoded-payload
+        objects (root/leaf/branch shared via the transport's payload
+        memo) produce ONE unique slot; distinct content stays
+        distinct even at equal values (identity, not equality)."""
+        root, leaf, br = b"r" * 32, b"leaf", (b"s" * 32,)
+        wave = HubWave(dedup=True)
+        for client in ("a", "b", "c"):
+            wave.add_branch(client, root, leaf, br, 1, ctx=client)
+        # equal VALUES under different identities must not collapse
+        # (bytes(bytearray(..)) forces fresh objects — same-code-object
+        # literals would be constant-folded to the very same constant)
+        wave.add_branch(
+            "d",
+            bytes(bytearray(root)),
+            bytes(bytearray(leaf)),
+            (bytes(bytearray(br[0])),),
+            1,
+            "d",
+        )
+        assert len(wave.b_slots) == 2
+        assert len(wave.b_items) == 4
+        assert [it[2] for it in wave.b_items] == [0, 0, 0, 1]
+        # non-dedup mode: every item is its own slot
+        wave2 = HubWave(dedup=False)
+        wave2.add_branch("a", root, leaf, br, 1, "a")
+        wave2.add_branch("b", root, leaf, br, 1, "b")
+        assert len(wave2.b_slots) == 2
 
 
 class TestHubLiveness:

@@ -64,6 +64,10 @@ BAD_FIXTURES = [
     # clock in protocol/ still gates
     "protocol/det001_obs_bad.py",
     "protocol/det002_bad.py",
+    # the columnar seam (ISSUE 7): direct BatchCrypto verify/decode
+    # from protocol/ outside hub.py gates, so the wave refactor can't
+    # silently erode back to scalar dispatch
+    "protocol/det003_bad.py",
     "protocol/conc001_bad.py",
     "transport/conc002_bad.py",
     "protocol/err001_bad.py",
@@ -71,6 +75,7 @@ BAD_FIXTURES = [
 GOOD_FIXTURES = [
     "protocol/det001_good.py",
     "protocol/det002_good.py",
+    "protocol/det003_good.py",
     "protocol/conc001_good.py",
     "transport/conc002_good.py",
     "protocol/err001_good.py",
@@ -156,6 +161,7 @@ def test_rule_catalog_registered():
     assert set(registered_rules()) == {
         "DET001",
         "DET002",
+        "DET003",
         "CONC001",
         "CONC002",
         "ERR001",

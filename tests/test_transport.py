@@ -90,6 +90,22 @@ def _auth(self_id, master=b"master", roster=_ROSTER):
 
 
 class TestAuthenticator:
+    def test_cached_schedule_matches_hmac_new(self):
+        """The precomputed inner/outer key schedule must be
+        byte-identical to stdlib HMAC-SHA256 — for short keys, the
+        64-byte block boundary, and over-long keys (hashed first)."""
+        import hashlib
+        import hmac as hmac_mod
+
+        from cleisthenes_tpu.transport.base import _hmac_sha256_fn
+
+        for key in (b"k", b"x" * 32, b"y" * 64, b"z" * 200):
+            fn = _hmac_sha256_fn(key)
+            for msg in (b"", b"m", b"payload" * 100):
+                assert fn(msg) == hmac_mod.new(
+                    key, msg, hashlib.sha256
+                ).digest()
+
     def test_sign_verify(self):
         n0, n1 = _auth("n0"), _auth("n1")
         msg = n0.sign(

@@ -53,8 +53,8 @@ def test_scalar_and_columnar_counts_agree():
     bank.batch_vote("n0", True, 0, True, tuple(MEMBERS))
     # scalar write-through for one instance from n1
     assert bank.bval_add(2, bank.sidx["n1"], True) == 2
-    assert int(bank.bval_cnt[2, 1]) == 2
-    assert int(bank.bval_cnt[0, 1]) == 1
+    assert int(bank.bval_cnt[1, 2]) == 2
+    assert int(bank.bval_cnt[1, 0]) == 1
     # duplicate scalar add is rejected
     assert bank.bval_add(2, bank.sidx["n1"], True) is None
 
@@ -77,14 +77,14 @@ def test_duplicate_proposers_in_one_frame_count_once():
     bank, bbas = _bank(f=1)
     dup = (MEMBERS[0],) * 5 + tuple(MEMBERS)
     bank.batch_vote("n0", True, 0, True, dup)
-    assert int(bank.bval_cnt[0, 1]) == 1  # one sender, one count
+    assert int(bank.bval_cnt[1, 0]) == 1  # one sender, one count
 
 
 def test_duplicate_frames_from_same_sender_count_once():
     bank, bbas = _bank(f=1)
     bank.batch_vote("n0", True, 0, True, tuple(MEMBERS))
     bank.batch_vote("n0", True, 0, True, tuple(MEMBERS))
-    assert int(bank.bval_cnt[0, 1]) == 1
+    assert int(bank.bval_cnt[1, 0]) == 1
 
 
 def test_stale_votes_drop_without_scalar_fallback():
@@ -92,7 +92,7 @@ def test_stale_votes_drop_without_scalar_fallback():
     bank.reset_row(0, 3)  # instance 0 is at round 3
     bank.batch_vote("n0", True, 1, True, (MEMBERS[0],))
     assert bbas[0].parked == []  # stale: vectorized drop
-    assert int(bank.bval_cnt[0, 1]) == 0
+    assert int(bank.bval_cnt[1, 0]) == 0
 
 
 def test_future_votes_park_via_scalar_fallback():
@@ -113,7 +113,7 @@ def test_halted_rows_drop_vectorized():
     bank.deactivate(1)
     bank.batch_vote("n0", True, 0, True, tuple(MEMBERS))
     assert int(bank.bval_cnt[1, 1]) == 0
-    assert int(bank.bval_cnt[0, 1]) == 1
+    assert int(bank.bval_cnt[1, 0]) == 1
 
 
 def test_aux_quorum_trigger_needs_bin_flags():
@@ -136,9 +136,9 @@ def test_reset_row_clears_everything():
     bank.batch_vote("n0", False, 0, False, tuple(MEMBERS))
     bank.set_bin(0, True)
     bank.reset_row(0, 1)
-    assert not bank.bval_seen[0].any()
-    assert not bank.aux_seen[0].any()
+    assert not bank.bval_seen[:, :, 0].any()
+    assert not bank.aux_seen[:, 0].any()
     assert not bank.bin_flags[0].any()
-    assert bank.row_round[0] == 1
+    assert bank.round_state[0] == 1
     # other rows untouched
-    assert bank.bval_seen[1].any()
+    assert bank.bval_seen[:, :, 1].any()

@@ -140,6 +140,13 @@ def append_bench_trend(result: Dict, path: str = str(DEFAULT_TREND)) -> int:
                     "tx_per_sec": side.get("tx_per_sec"),
                     "stage_shares": side.get("stage_shares"),
                     "hub_dispatches": side.get("hub_dispatches_cluster"),
+                    # columnar-wave counters (ISSUE 7): present on
+                    # protocol sections since the wave-batched hub
+                    "dispatches_per_epoch": side.get(
+                        "dispatches_per_epoch"
+                    ),
+                    "wave_width_p50": side.get("wave_width_p50"),
+                    "wave_width_p95": side.get("wave_width_p95"),
                 }
                 append_record(path, record)
                 appended += 1

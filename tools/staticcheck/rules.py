@@ -225,6 +225,82 @@ class Det002SetIterationOrder:
 
 
 # ---------------------------------------------------------------------------
+# DET003: crypto verify/decode must route through the hub's columnar seam
+# ---------------------------------------------------------------------------
+#
+# The wave-columnar refactor (ISSUE 7) moved every protocol-plane
+# batch-crypto execution behind CryptoHub: clients stage work and
+# drain it into a HubWave's typed columns; ONE dispatch per work kind
+# runs per flush.  A direct BatchCrypto verify/decode call from
+# protocol/ code outside hub.py silently erodes that seam back to
+# scalar per-instance dispatch — the exact regression the refactor
+# removed (hub_dispatches_cluster 24-37/epoch -> O(work kinds)).
+# The rule flags calls to the verify/decode surfaces of the crypto
+# layer (merkle verify_branch/verify_batch, RS decode_batch/
+# decode_recheck_batch, threshold-share verify_* — as methods or as
+# from-imported ops functions) anywhere under protocol/ except
+# hub.py itself.  Legitimate inline checks (RBC's single VAL-branch
+# precheck; the lockstep spmd.py plane, which IS its own columnar
+# batch layer and never touches the hub) carry allow[DET003] pragmas
+# with justifications.
+
+_DET003_CALLS = frozenset(
+    (
+        "verify_branch",
+        "verify_batch",
+        "decode_batch",
+        "decode_recheck_batch",
+        "verify_shares",
+        "verify_share_groups",
+        "verify_and_combine_share_groups",
+        "verify_dec_shares",
+    )
+)
+_DET003_EXEMPT_FILES = frozenset(("hub.py",))
+
+
+@rule
+class Det003HubColumnarSeam:
+    id = "DET003"
+    doc = (
+        "no direct BatchCrypto verify/decode calls from protocol/ "
+        "outside hub.py; stage the work and drain it through the "
+        "CryptoHub wave (drain_pending) so it batches columnar"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        parts = ctx.relpath.split("/")
+        if "protocol" not in parts or parts[-1] in _DET003_EXEMPT_FILES:
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            name = None
+            if isinstance(func, ast.Attribute):
+                if func.attr in _DET003_CALLS:
+                    name = func.attr
+            elif isinstance(func, ast.Name):
+                # from-imported ops function (ctx.resolve maps the
+                # local name through import aliases)
+                dotted = ctx.resolve(func)
+                if (
+                    dotted
+                    and ".ops." in f".{dotted}"
+                    and dotted.rsplit(".", 1)[-1] in _DET003_CALLS
+                ):
+                    name = dotted
+            if name is not None:
+                yield ctx.finding(
+                    self.id,
+                    node,
+                    f"direct crypto dispatch {name}() bypasses the "
+                    "hub's columnar seam; stage the work and offer it "
+                    "via drain_pending(wave) instead",
+                )
+
+
+# ---------------------------------------------------------------------------
 # CONC001: lock discipline for @guarded_by-annotated attributes
 # ---------------------------------------------------------------------------
 #
@@ -456,6 +532,7 @@ class Err001SwallowedExceptions:
 __all__ = [
     "Det001WallClockAndEntropy",
     "Det002SetIterationOrder",
+    "Det003HubColumnarSeam",
     "Conc001LockDiscipline",
     "Conc002BlockingInHandlers",
     "Err001SwallowedExceptions",
