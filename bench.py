@@ -19,11 +19,19 @@ Sections, one JSON line total (the driver contract):
    dispatches — 'tpu' vs 'cpu' backend.  Warm-up epochs consume their
    own transactions; measured epochs are guaranteed PROTO_EPOCHS.
 
-3. **N=512 pipelined crypto plane** (BASELINE config 5): the crypto
-   work of consecutive epochs at N=512/f=170 with the protocol's
-   actual threshold-limited share-verify load, run back-to-back so
-   epoch e+1's RS/Merkle stage overlaps epoch e's share verification
-   in one measurement window.
+3. **Order-then-settle overlap** (ISSUE 8): chained real-protocol
+   epochs through the two-frontier commit split
+   (Config.order_then_settle) vs the coupled arm on the identical
+   seeded workload — ``pipeline_overlap_x`` is serial epoch walls /
+   elapsed wall, so > 1.0 certifies epoch e+1's RBC/BBA genuinely ran
+   under epoch e's trailing decryption.  (Replaces the retired
+   crypto_n512_pipelined software-pipeline section, whose ~0.95
+   "overlap" measured one dispatch queue against itself.)
+
+4. **Same-box interleaved A/B** (``--ab BASE_REF``): HEAD vs a named
+   git ref run alternately in one harness lifetime with paired
+   deltas (tools/abench.py) — cross-box BENCH_* comparisons do not
+   reproduce (WAVE_EVIDENCE.md), paired same-box runs do.
 
 ``platform`` records where the XLA path actually ran ('axon' = real
 TPU via the relay, 'cpu' = XLA-on-host fallback) so every recorded
@@ -99,18 +107,17 @@ PROTO_CONFIGS = {
 if os.environ.get("BENCH_FULL") == "1":
     PROTO_CONFIGS["protocol_n128"] = {"n": 128, "batch": 2048, "epochs": 1}
 
-# ---- config-5 pipelined crypto plane ----
-P512_N = 512
-P512_F = 170
-P512_BATCH = 4096
-P512_EPOCHS = 3
-# GF(2^8) RS admits at most 256 distinct shard indices — the SAME cap
-# as the reference's klauspost/reedsolomon dependency (256 total
-# shards).  The 512-validator run therefore batches 512 concurrent
-# instances on the validator axis while each instance codes at the
-# field-limit shard count, rate-matched to N=512's (n-2f)/n = 172/512:
-P512_SHARDS = 256
-P512_K = 86
+# ---- order-then-settle overlap section (ISSUE 8) ----
+# The retired crypto_n512_pipelined section measured a SOFTWARE
+# pipeline over one dispatch queue (overlap_x ~0.95 — sequential was
+# as fast as "pipelined").  pipeline_overlap_x now means what its
+# name says: real protocol epochs chained through the two-frontier
+# commit split, epoch e+1's RBC/BBA overlapping epoch e's trailing
+# decryption, measured as sum(per-epoch propose->settle walls) over
+# the elapsed wall (> 1.0 = epochs genuinely overlapped).
+OVERLAP_N = 16
+OVERLAP_BATCH = 512
+OVERLAP_EPOCHS = 4
 
 
 def payload_bytes(n: int = N, batch: int = BATCH_TXS) -> int:
@@ -245,6 +252,22 @@ def build_network(
     return cfg, cluster.net, cluster.nodes, cluster
 
 
+def two_frontier_keys(metrics) -> dict:
+    """The two-frontier per-epoch latencies every protocol section
+    reports (ISSUE 8): propose -> ciphertext-ordered commit (what the
+    application's ordering sees), propose -> settled plaintext, and
+    the trailing decrypt lag's p95.  None on the coupled arm.
+    perfgate/abench key on these exact names."""
+    return {
+        key: round(val * 1000.0, 3) if val is not None else None
+        for key, val in (
+            ("ordered_epoch_p50_ms", metrics.ordered_latency.p50),
+            ("settled_epoch_p50_ms", metrics.epoch_latency.p50),
+            ("decrypt_lag_p95_ms", metrics.settle_lag_latency.p95),
+        )
+    }
+
+
 def measure_protocol(
     backend: str,
     n: int,
@@ -325,6 +348,7 @@ def measure_protocol(
         out["wave_width_p95"] = widths[
             max(0, int(round(0.95 * (len(widths) - 1))))
         ]
+    out.update(two_frontier_keys(nodes[node_ids[0]].metrics))
     if trace:
         from cleisthenes_tpu.utils.trace import to_chrome
         from tools import tracetool
@@ -488,170 +512,157 @@ def protocol_section(backend_accel: str, backend_cpu: str, n: int,
 
 
 # ---------------------------------------------------------------------------
-# BASELINE config 5: N=512 pipelined crypto plane
+# order-then-settle overlap: the REAL pipelining number (ISSUE 8)
 # ---------------------------------------------------------------------------
 
 
-def measure_n512_pipelined(backend: str) -> dict:
-    """Multi-epoch crypto plane at N=512/f=170 (BASELINE config 5).
+def measure_order_overlap(
+    backend: str,
+    n: int = OVERLAP_N,
+    batch: int = OVERLAP_BATCH,
+    epochs: int = OVERLAP_EPOCHS,
+    order_then_settle: bool = True,
+) -> dict:
+    """Chained protocol epochs through the two-frontier commit split:
+    transactions pre-submitted, ``auto_propose`` on, ONE ``net.run``
+    drives every epoch back to back, so epoch e+1's RBC/BBA genuinely
+    overlaps epoch e's trailing decryption (Config.order_then_settle).
 
-    Per epoch, the protocol's actual device work in two stages:
-      A. RS-encode all N proposals, Merkle forest, branch wave check,
-         RS-decode  (the RBC plane)
-      B. the threshold-limited share-verify load 2*N*(f+1) CP proofs
-         (what the live wave-deferred hub dispatches — NOT naive N^2)
+    ``pipeline_overlap_x`` = sum of per-epoch propose->settle walls /
+    elapsed wall.  Strictly sequential epochs score <= 1.0; overlap
+    pushes it above 1.0.  ``order_then_settle=False`` measures the
+    coupled arm of the SAME workload — the honest comparison the
+    retired crypto_n512_pipelined section never had."""
+    from cleisthenes_tpu.config import Config
+    from cleisthenes_tpu.protocol.cluster import SimulatedCluster
 
-    Pipelining: epoch e+1's stage A runs on the caller thread while
-    epoch e's stage B drains on a worker thread — the two-deep
-    software pipeline of BASELINE config 5 ("overlap epoch e+1's RS
-    encode with epoch e's decrypt").  Both stages release the GIL into
-    XLA/native kernels, so the overlap is real on multi-core hosts and
-    on the TPU (device queue vs host-bound verify); a sequential run
-    of the same epochs is measured alongside, and the speedup is
-    reported as ``pipeline_overlap_x`` (~1.0 on a single-core host).
-    """
-    import concurrent.futures
-    from cleisthenes_tpu.ops.backend import BatchCrypto
-    from cleisthenes_tpu.ops.payload import split_payload
-    from cleisthenes_tpu.ops import tpke as tpke_mod
-
-    n, f = P512_N, P512_F
-    shards, k = P512_SHARDS, P512_K
-    crypto = BatchCrypto(backend, shards, (shards - k) // 2, k)
-    rng = np.random.default_rng(31)
-    plen = payload_bytes(n, P512_BATCH)
-    data = np.stack(
-        [
-            split_payload(
-                rng.integers(0, 256, size=plen, dtype=np.uint8).tobytes(), k
-            )
-            for _ in range(n)
-        ]
+    cfg = Config(
+        n=n,
+        batch_size=batch,
+        crypto_backend=backend,
+        seed=99,
+        order_then_settle=order_then_settle,
     )
-    pub, secrets_ = tpke_mod.deal(n, f + 1, seed=123)
-    ct = tpke_mod.Tpke(pub).encrypt(b"epoch-key")
-    ctx = b"cfg5-ctx"
-    # threshold-limited verify load: 2 share groups (dec + coin shape)
-    # of (f+1) proofs per instance => 2*n*(f+1) CP checks per epoch
-    shares = [
-        tpke_mod.issue_share(secrets_[i % n], ct.c1, ctx)
-        for i in range(f + 1)
-    ]
-    n_share_checks = 2 * n * (f + 1)
-    engine_backend = "cpu" if backend == "cpp" else backend
-
-    def make_stage_a(c):
-        """Epoch RBC plane: encode + forest + branch wave + decode —
-        one body, instantiated per crypto backend so the sequential
-        reference and the pipelined run measure identical work."""
-
-        def stage_a():
-            encoded = c.erasure.encode_batch(data)
-            trees = c.merkle.build_batch(encoded)
-            roots = np.stack(
-                [np.frombuffer(t.root, dtype=np.uint8) for t in trees]
-            )
-            leaves = encoded[:, 0, :]
-            depth = trees[0].depth
-            branches = np.stack(
-                [
-                    np.stack(
-                        [np.frombuffer(s, dtype=np.uint8) for s in t.branch(0)]
-                    )
-                    for t in trees
-                ]
-            ).reshape(n, depth, 32)
-            ok = c.merkle.verify_batch(
-                roots, leaves, branches, np.zeros(n, dtype=np.int64)
-            )
-            assert bool(ok.all())
-            survivor = np.arange(shards - k, shards)
-            c.erasure.decode_batch(
-                np.tile(survivor, (n, 1)), encoded[:, survivor, :]
-            )
-
-        return stage_a
-
-    stage_a = make_stage_a(crypto)
-
-    def stage_b():
-        """Epoch share-verify plane (decrypt + coin verification)."""
-        remaining = n_share_checks
-        while remaining > 0:
-            chunk = min(remaining, SHARE_VERIFY_CHUNK)
-            batch_shares = (shares * ((chunk // len(shares)) + 1))[:chunk]
-            res = tpke_mod.verify_shares(
-                pub, ct.c1, batch_shares, ctx, backend=engine_backend
-            )
-            assert all(res)
-            remaining -= chunk
-
-    # On the TPU backend, round-3 measured the two-device-wave
-    # pipeline at 0.6x (both stages feed ONE dispatch queue over the
-    # relay — interleaving them from two threads just reorders the
-    # same serialized queue, plus thread overhead).  The overlap that
-    # CAN win pairs different execution units: epoch e+1's RBC plane
-    # on the HOST's native kernels while epoch e's share-verify plane
-    # drains on the device (r4 verdict item 7).
-    stage_a_host = None
-    if backend == "tpu":
-        stage_a_host = make_stage_a(
-            BatchCrypto(
-                cpu_reference_backend(), shards, (shards - k) // 2, k
-            )
+    cluster = SimulatedCluster(
+        config=cfg, key_seed=77, auto_propose=True, shared_hub=True
+    )
+    rng = np.random.default_rng(13)
+    node_ids = cluster.ids
+    # warm-up epoch (jit compile, caches) with its own transactions —
+    # add_transaction never opens an epoch, so the kick is explicit
+    for i in range(batch):
+        cluster.nodes[node_ids[i % n]].add_transaction(
+            rng.integers(0, 256, size=TX_BYTES, dtype=np.uint8).tobytes()
         )
-
-    # warm-up / compile: only the stage-A variant the timed loops use
-    if stage_a_host is not None:
-        stage_a_host()
-    else:
-        stage_a()
-    stage_b()
-    # the pipelined run's stage-A placement; the SEQUENTIAL REFERENCE
-    # uses the same placement, so pipeline_overlap_x isolates overlap
-    # and cannot be inflated by the host plane merely being faster
-    # than the device plane (code-review finding)
-    pipe_a = stage_a_host if stage_a_host is not None else stage_a
-    # sequential reference: epochs strictly one after another
-    t0 = time.perf_counter()
-    for _ in range(P512_EPOCHS):
-        pipe_a()
-        stage_b()
-    seq_wall = time.perf_counter() - t0
-    # two-deep pipeline: e+1's RBC plane overlaps e's share verify;
-    # on tpu the RBC plane runs on the host's native kernels so the
-    # overlapped units are genuinely different (host cores vs device)
-    with concurrent.futures.ThreadPoolExecutor(1) as pool:
-        t0 = time.perf_counter()
-        tail = None
-        for _ in range(P512_EPOCHS):
-            pipe_a()
-            if tail is not None:
-                tail.result()
-            tail = pool.submit(stage_b)
-        tail.result()
-        pipe_wall = time.perf_counter() - t0
-    return {
+    for hb in cluster.nodes.values():
+        hb.start_epoch()
+    cluster.net.run()
+    n0 = cluster.nodes[node_ids[0]]
+    assert n0.settled_epoch >= 1, "warm-up epoch did not commit"
+    for i in range(batch * epochs):
+        cluster.nodes[node_ids[i % n]].add_transaction(
+            rng.integers(0, 256, size=TX_BYTES, dtype=np.uint8).tobytes()
+        )
+    # time.monotonic, NOT perf_counter: the window filter below
+    # compares t0 against Metrics' phase stamps, which are
+    # time.monotonic values — the two clocks' epochs are not
+    # comparable on every platform
+    t0 = time.monotonic()
+    for hb in cluster.nodes.values():  # kick; auto-propose chains on
+        hb.start_epoch()
+    cluster.net.run()
+    elapsed = time.monotonic() - t0
+    assert n0.settled_epoch == n0.epoch, "run ended with unsettled epochs"
+    histories = {
+        tuple(tuple(sorted(b.tx_list())) for b in hb.committed_batches)
+        for hb in cluster.nodes.values()
+    }
+    assert len(histories) == 1, "overlap benchmark broke agreement"
+    m = n0.metrics
+    # per-epoch serial walls from the metrics phase traces: the warm-up
+    # epoch predates t0, so only spans measured inside the window count
+    measured = [
+        (e, tp, tc)
+        for e, tp, tc in m.epoch_spans()
+        if tp >= t0 - 1e-9 and tc is not None
+    ]
+    spans = [(tp, tc) for _e, tp, tc in measured]
+    serial = sum(tc - tp for tp, tc in spans)
+    # THE two-frontier certificate: how much of the ordered->settled
+    # lag (the trailing decrypt track) ran hidden under some epoch's
+    # protocol window [propose, ordered].  The coupled arm has no
+    # settle track at all (t_ordered unset) and scores 0 — unlike the
+    # serial/elapsed ratio, which the pre-existing proposal pipelining
+    # inflates on BOTH arms.
+    protocol_iv = []
+    settle_iv = []
+    for e, tp, tc in measured:
+        t_ord = m.trace(e).t_ordered
+        protocol_iv.append((tp, t_ord if t_ord is not None else tc))
+        if t_ord is not None:
+            settle_iv.append((t_ord, tc))
+    merged = []
+    for p0, p1 in sorted(protocol_iv):
+        if merged and p0 <= merged[-1][1]:
+            merged[-1] = (merged[-1][0], max(merged[-1][1], p1))
+        else:
+            merged.append((p0, p1))
+    settle_total = sum(s1 - s0 for s0, s1 in settle_iv)
+    settle_hidden = sum(
+        max(0.0, min(s1, p1) - max(s0, p0))
+        for s0, s1 in settle_iv
+        for p0, p1 in merged
+    )
+    out = {
         "n": n,
-        "f": f,
-        "batch": P512_BATCH,
-        "epochs": P512_EPOCHS,
-        "rs_shards": shards,  # GF(2^8) field cap, same as klauspost's
-        "rs_k": k,
-        "epoch_p50_ms": round(pipe_wall / P512_EPOCHS * 1000.0, 3),
-        "pipelined_wall_ms": round(pipe_wall * 1000.0, 3),
-        "sequential_wall_ms": round(seq_wall * 1000.0, 3),
-        "pipeline_overlap_x": round(seq_wall / pipe_wall, 3)
-        if pipe_wall > 0
-        else None,
-        # which unit ran the overlapped RBC plane: on tpu it is the
-        # HOST's native kernels (device-on-device overlap measured
-        # 0.6x in r3 — one dispatch queue), so overlap > 1 means the
-        # host plane genuinely hid under the device's verify drain
-        "pipelined_stage_a": (
-            "host-native" if stage_a_host is not None else backend
+        "batch": batch,
+        "mode": (
+            "order_then_settle" if order_then_settle else "coupled"
         ),
-        "share_checks_per_epoch": n_share_checks,
+        "measured_epochs": len(spans),
+        "elapsed_wall_ms": round(elapsed * 1000.0, 3),
+        "serial_epoch_walls_ms": round(serial * 1000.0, 3),
+        # > 1.0 means epochs genuinely overlapped (an epoch's settle
+        # ran under a later epoch's RBC/BBA); sequential epochs bound
+        # this at <= ~1.0 by construction
+        "pipeline_overlap_x": (
+            round(serial / elapsed, 3) if elapsed > 0 else None
+        ),
+        # fraction of the settle track hidden under protocol windows
+        # (0.0 on the coupled arm — it has no settle track)
+        "settle_hidden_frac": (
+            round(settle_hidden / settle_total, 3)
+            if settle_total > 0
+            else 0.0
+        ),
+        "settle_track_ms": round(settle_total * 1000.0, 3),
+        "epoch_p50_ms": (
+            round(statistics.median([tc - tp for tp, tc in spans])
+                  * 1000.0, 3)
+            if spans
+            else None
+        ),
+    }
+    out.update(two_frontier_keys(m))
+    return out
+
+
+def order_overlap_section(backend: str) -> dict:
+    """Both arms of the same seeded workload: the two-frontier split
+    vs the coupled commit path — paired on one box, back to back."""
+    split = measure_order_overlap(backend, order_then_settle=True)
+    coupled = measure_order_overlap(backend, order_then_settle=False)
+    return {
+        "n": OVERLAP_N,
+        "batch": OVERLAP_BATCH,
+        "epochs": OVERLAP_EPOCHS,
+        "order_then_settle": split,
+        "coupled": coupled,
+        # the headline: settled-throughput ratio of split vs coupled
+        # on identical submitted work (elapsed wall, lower is better)
+        "split_vs_coupled_wall_x": _vs(
+            coupled["elapsed_wall_ms"], split["elapsed_wall_ms"]
+        ),
     }
 
 
@@ -817,27 +828,17 @@ def run_child() -> None:
                 n512_cpu["epoch_p50_ms"], n512_tpu["epoch_p50_ms"]
             ),
         }
+    # order-then-settle overlap (ISSUE 8): replaces the retired
+    # crypto_n512_pipelined section — a software pipeline over one
+    # dispatch queue whose overlap_x ~0.95 said nothing.  Runs on the
+    # REAL protocol path; the CPU arm is the headline (the split is a
+    # protocol-structure win, not a chip win), with an accelerated arm
+    # recorded when a TPU is attached.
+    progress("order_overlap cpu")
+    out["order_overlap"] = {"cpu": order_overlap_section(cpu_ref)}
     if on_tpu:
-        progress("crypto_n512_pipelined tpu")
-        out["crypto_n512_pipelined"] = {
-            "tpu": measure_n512_pipelined("tpu"),
-        }
-        progress("crypto_n512_pipelined cpu")
-        out["crypto_n512_pipelined"]["cpu"] = measure_n512_pipelined(
-            cpu_ref
-        )
-        out["crypto_n512_pipelined"]["vs_cpu"] = _vs(
-            out["crypto_n512_pipelined"]["cpu"]["epoch_p50_ms"],
-            out["crypto_n512_pipelined"]["tpu"]["epoch_p50_ms"],
-        )
-    else:  # fallback: XLA-on-host accelerated side is pure budget burn
-        progress("crypto_n512_pipelined cpu")
-        out["crypto_n512_pipelined"] = {
-            "tpu": None,
-            "cpu": measure_n512_pipelined(cpu_ref),
-            "vs_cpu": None,
-            "note": "accelerated side skipped: no TPU attached",
-        }
+        progress("order_overlap tpu")
+        out["order_overlap"]["tpu"] = order_overlap_section("tpu")
     progress("modexp_wide")
     if on_tpu:
         # first time these wide-limb programs meet a real chip: a
@@ -1109,9 +1110,35 @@ def run_trace() -> None:
     print(json.dumps(doc))
 
 
+def run_ab() -> None:
+    """bench.py --ab BASE_REF [...]: same-box interleaved A/B vs a git
+    ref with paired deltas (tools/abench.py) — the comparison form
+    that survives the cross-box irreproducibility WAVE_EVIDENCE.md
+    documents.  Holds the measurement lock like every other mode."""
+    argv = list(sys.argv[1:])
+    argv.remove("--ab")
+    from tools import abench
+
+    try:
+        with benchlock.hold("bench.py --ab"):
+            sys.exit(abench.main(argv))
+    except TimeoutError as exc:
+        print(
+            json.dumps(
+                {
+                    "metric": "abench_paired",
+                    "error": f"bench lock unavailable: {exc}",
+                }
+            )
+        )
+        sys.exit(1)
+
+
 if __name__ == "__main__":
     if "--child" in sys.argv:
         run_child()
+    elif "--ab" in sys.argv:
+        run_ab()
     elif "--trace" in sys.argv:
         run_trace()
     else:

@@ -92,6 +92,12 @@ class Config:
         backpressure alarm fires (ingress outrunning commit).
       slo_peer_lag_epochs: epoch-frontier gap above which a trailing
         peer counts as lagging (peer-lag detector; in-proc clusters).
+      order_then_settle: two-frontier commit split (see the field
+        comment below): ciphertext-ordered commit at ACS output, with
+        threshold decryption trailing in an idle-driven settler.
+      decrypt_lag_max: backpressure bound on ordered-ahead epochs
+        (ordered frontier - settled frontier); also the settle-stall
+        SLO watchdog's lag budget.
     """
 
     n: int = 4
@@ -128,6 +134,26 @@ class Config:
     # of the cross-path equivalence test (seeded runs must commit
     # byte-identical ledgers under either discipline).
     hub_wave_flush: bool = True
+    # Order-then-decrypt (the two-frontier commit split, after "The
+    # Latency Price of Threshold Cryptosystems in Blockchains"): at
+    # ACS output the epoch commits its CIPHERTEXT-ORDERED batch — a
+    # deterministic {proposer: ct} record, WAL-durable as a COrd
+    # record — and the epoch counter advances immediately, so epoch
+    # e+1's RBC/BBA runs at full speed while epoch e's TPKE dec-share
+    # verify/combine trails in a settler driven from the transports'
+    # idle callbacks.  The settled frontier writes the plaintext CLOG
+    # record, applies the dedup filter and fires on_commit, strictly
+    # in epoch order.  False = the coupled arm: commit blocks on the
+    # full decryption exchange exactly as before (kept as the
+    # byte-equivalence comparison arm — same seed, same settled
+    # plaintext log).
+    order_then_settle: bool = True
+    # Bounded ordered-but-unsettled window: the ordered frontier may
+    # run at most this many epochs ahead of the settled frontier
+    # before ordering parks (backpressure).  A Byzantine coalition
+    # delaying settlement (share forgery) therefore stalls ordering
+    # AT this bound, never unboundedly ahead of durable plaintext.
+    decrypt_lag_max: int = 4
 
     def __post_init__(self) -> None:
         if self.n < 1:
@@ -178,6 +204,11 @@ class Config:
                 f"SLO thresholds must be > 0: queue_depth="
                 f"{self.slo_queue_depth} peer_lag="
                 f"{self.slo_peer_lag_epochs}"
+            )
+        if self.decrypt_lag_max < 1:
+            raise ValueError(
+                f"decrypt_lag_max={self.decrypt_lag_max} must be >= 1 "
+                "(1 = order at most one epoch ahead of settlement)"
             )
         if self.mesh_shape is not None:
             from cleisthenes_tpu.parallel.mesh import validate_mesh_shape

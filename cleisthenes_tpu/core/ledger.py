@@ -10,7 +10,7 @@ the epoch after its last commit instead of epoch 0.
 
 Record format (all big-endian, following transport.message's TLV
 style):  magic | u32 record_len | body | u32 crc32(record body), with
-two record magics:
+three record magics:
 
   "CLOG" — committed batch: u64 epoch | u32 n_proposers | per
   proposer (u32 id_len | id | u32 n_txs | per tx (u32 len | bytes)).
@@ -22,6 +22,16 @@ two record magics:
   filter seeds from the LAST checkpoint and folds only the batches
   logged after it, instead of re-deriving tx sets from every batch in
   the log.
+
+  "COrd" — ciphertext-ordered commit (Config.order_then_settle): u64
+  epoch | u32 n_proposers | per proposer, sorted (u32 id_len | id |
+  u32 ct_len | ct_bytes) — the agreed ACS output as raw RBC values,
+  durable BEFORE threshold decryption runs.  Epoch e's COrd precedes
+  its CLOG in the file; a crash between them leaves an ordered-ahead
+  epoch that a restart re-enters into the settler (the ordering is
+  never re-run).  The body bytes are a pure function of the agreed
+  output map, so honest nodes' ordered logs are byte-identical —
+  the cross-frontier fuzz invariant.
 
 A torn tail (crash mid-append) is detected by length/CRC and
 truncated away on open.  The fsync-on-commit policy is
@@ -41,6 +51,7 @@ from cleisthenes_tpu.utils.determinism import guarded_by
 
 _MAGIC = b"CLOG"
 _MAGIC_CKPT = b"CCKP"
+_MAGIC_ORD = b"COrd"
 
 
 def encode_batch_body(epoch: int, batch: Batch) -> bytes:
@@ -54,6 +65,48 @@ def encode_batch_body(epoch: int, batch: Batch) -> bytes:
 
 def decode_batch_body(body: bytes) -> Tuple[int, Batch]:
     return _decode_body(body)
+
+
+def encode_ordered_body(epoch: int, output: Dict[str, bytes]) -> bytes:
+    """The COrd record body: the epoch's agreed {proposer: raw RBC
+    value} map in sorted-proposer order.  Deterministic bytes for a
+    given ACS output — also the payload of ordered CATCHUP responses
+    (transport.message.CatchupOrdPayload), so f+1 "identical bodies"
+    means f+1 identical ORDERING records."""
+    out: List[bytes] = [struct.pack(">Q", epoch)]
+    out.append(struct.pack(">I", len(output)))
+    for proposer in sorted(output):
+        pid = proposer.encode("utf-8")
+        out.append(struct.pack(">I", len(pid)))
+        out.append(pid)
+        ct = output[proposer]
+        out.append(struct.pack(">I", len(ct)))
+        out.append(ct)
+    return b"".join(out)
+
+
+def decode_ordered_body(body: bytes) -> Tuple[int, Dict[str, bytes]]:
+    off = 0
+
+    def u32() -> int:
+        nonlocal off
+        (v,) = struct.unpack_from(">I", body, off)
+        off += 4
+        return v
+
+    (epoch,) = struct.unpack_from(">Q", body, off)
+    off += 8
+    output: Dict[str, bytes] = {}
+    for _ in range(u32()):
+        id_len = u32()
+        proposer = body[off : off + id_len].decode("utf-8")
+        off += id_len
+        ct_len = u32()
+        output[proposer] = body[off : off + ct_len]
+        off += ct_len
+    if off != len(body):
+        raise ValueError("trailing bytes in ordered record")
+    return epoch, output
 
 
 def _encode_body(epoch: int, batch: Batch) -> bytes:
@@ -151,7 +204,10 @@ def _decode_body(body: bytes) -> Tuple[int, Batch]:
     return epoch, Batch(contributions=contributions)
 
 
-@guarded_by("_lock", "_fh", "_last_epoch", "_last_checkpoint")
+@guarded_by(
+    "_lock", "_fh", "_last_epoch", "_last_checkpoint",
+    "_last_ordered_epoch",
+)
 class BatchLog:
     """Append-only durable log of committed batches.
 
@@ -166,6 +222,7 @@ class BatchLog:
         self._lock = threading.Lock()
         self._last_epoch: Optional[int] = None
         self._last_checkpoint: Optional[Tuple[int, List[Set[bytes]]]] = None
+        self._last_ordered_epoch: Optional[int] = None
         # flight recorder (utils/trace.py), set by the owning node
         # when Config.trace is on: every append/checkpoint records a
         # "ledger" span (write+flush+fsync cost is a real commit-path
@@ -183,7 +240,11 @@ class BatchLog:
         off = 0
         while off + 8 <= len(data):
             magic = data[off : off + 4]
-            if magic != _MAGIC and magic != _MAGIC_CKPT:
+            if (
+                magic != _MAGIC
+                and magic != _MAGIC_CKPT
+                and magic != _MAGIC_ORD
+            ):
                 return
             (body_len,) = struct.unpack_from(">I", data, off + 4)
             end = off + 8 + body_len + 4
@@ -196,6 +257,8 @@ class BatchLog:
             try:
                 if magic == _MAGIC:
                     _decode_body(body)
+                elif magic == _MAGIC_ORD:
+                    decode_ordered_body(body)
                 else:
                     _decode_checkpoint_body(body)
             except (ValueError, struct.error, UnicodeDecodeError):
@@ -214,6 +277,10 @@ class BatchLog:
         for end, magic, body in self._scan(data):
             if magic == _MAGIC:
                 self._last_epoch, _ = _decode_body(body)
+            elif magic == _MAGIC_ORD:
+                (self._last_ordered_epoch,) = struct.unpack_from(
+                    ">Q", body, 0
+                )
             else:
                 epoch, history = _decode_checkpoint_body(body)
                 self._last_checkpoint = (epoch, history)
@@ -238,6 +305,33 @@ class BatchLog:
         if tr is not None:
             tr.complete(
                 "ledger", "wal_append", t0, epoch=epoch, bytes=len(rec)
+            )
+
+    def append_ordered(self, epoch: int, output: Dict[str, bytes]) -> bytes:
+        """Durably record ``epoch``'s ciphertext-ordered commit (the
+        agreed ACS output) BEFORE threshold decryption runs — the
+        ordered frontier's WAL write (Config.order_then_settle).
+        Returns the encoded body (the bytes CATCHUP serves and the
+        cross-node byte-identity invariant compares)."""
+        body = encode_ordered_body(epoch, output)
+        self.append_ordered_body(epoch, body)
+        return body
+
+    def append_ordered_body(self, epoch: int, body: bytes) -> None:
+        """``append_ordered`` for a body already in hand (a COrd
+        catch-up adoption): the WAL persists the EXACT bytes the
+        quorum agreed on, so the durable record, the catch-up serving
+        store, and the fuzzer's byte-identity witness can never
+        diverge."""
+        rec = _frame_record(_MAGIC_ORD, body)
+        tr = self.trace
+        t0 = 0.0 if tr is None else tr.now()
+        with self._lock:
+            self._append_record_locked(rec)
+            self._last_ordered_epoch = epoch
+        if tr is not None:
+            tr.complete(
+                "ledger", "wal_ordered", t0, epoch=epoch, bytes=len(rec)
             )
 
     def append_checkpoint(
@@ -268,10 +362,28 @@ class BatchLog:
             if magic == _MAGIC:
                 yield _decode_body(body)
 
+    def replay_ordered(self) -> Iterator[Tuple[int, bytes]]:
+        """All ciphertext-ordered (epoch, COrd body) records, oldest
+        first.  A restart settles ordered-ahead epochs (COrd with no
+        matching CLOG yet) from here — the ordering is never re-run."""
+        with open(self.path, "rb") as fh:
+            data = fh.read()
+        for _end, magic, body in self._scan(data):
+            if magic == _MAGIC_ORD:
+                (epoch,) = struct.unpack_from(">Q", body, 0)
+                yield epoch, body
+
     @property
     def last_epoch(self) -> Optional[int]:
         with self._lock:
             return self._last_epoch
+
+    @property
+    def last_ordered_epoch(self) -> Optional[int]:
+        """Epoch of the newest COrd record, or None when the log holds
+        no (intact) ordered record."""
+        with self._lock:
+            return self._last_ordered_epoch
 
     @property
     def last_checkpoint(self) -> Optional[Tuple[int, List[Set[bytes]]]]:
@@ -285,4 +397,10 @@ class BatchLog:
             self._fh.close()
 
 
-__all__ = ["BatchLog", "encode_batch_body", "decode_batch_body"]
+__all__ = [
+    "BatchLog",
+    "encode_batch_body",
+    "decode_batch_body",
+    "encode_ordered_body",
+    "decode_ordered_body",
+]

@@ -187,6 +187,7 @@ class SimulatedCluster:
                 peer_lag_epochs=self.config.slo_peer_lag_epochs,
                 peer_states_fn=lambda nid=nid: self.net.link_states(nid),
                 peer_lag_fn=lambda nid=nid: self._peer_lag(nid),
+                decrypt_lag_budget=self.config.decrypt_lag_max,
                 trace=self.nodes[nid].trace,
             )
             self.nodes[nid].metrics.set_alerts(wd.alerts_block)

@@ -30,10 +30,16 @@ from __future__ import annotations
 import dataclasses
 import hashlib
 import struct
-from typing import Callable, Dict, List, Optional, Sequence, Set
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
 
 from cleisthenes_tpu.config import Config
 from cleisthenes_tpu.core.batch import Batch
+from cleisthenes_tpu.core.ledger import (
+    decode_batch_body,
+    decode_ordered_body,
+    encode_batch_body,
+    encode_ordered_body,
+)
 from cleisthenes_tpu.core.queue import TxQueue
 from cleisthenes_tpu.protocol.hub import _Memo
 from cleisthenes_tpu.ops import tpke as tpke_mod
@@ -58,6 +64,7 @@ from cleisthenes_tpu.transport.message import (
     BbaBatchPayload,
     BbaPayload,
     BundlePayload,
+    CatchupOrdPayload,
     CatchupReqPayload,
     CatchupRespPayload,
     CoinBatchPayload,
@@ -318,9 +325,16 @@ class _EpochState:
         "opt_failed",
         "opt_short",
         "committed",
+        "ordered",
+        "shares_issued",
+        "t_ordered",
     )
 
-    def __init__(self, acs: ACS) -> None:
+    def __init__(self, acs: Optional[ACS]) -> None:
+        # ``acs`` is None for SETTLE-ONLY states (two-frontier mode):
+        # epochs whose ordering is already durable — WAL replay after a
+        # crash between COrd and CLOG, or COrd catch-up adoption — that
+        # only need the trailing decryption, never a consensus re-run.
         self.acs = acs
         self.proposed = False
         self.my_txs: List[bytes] = []
@@ -339,6 +353,12 @@ class _EpochState:
         # exact-crossing trigger alone would stall them forever
         self.opt_short: Set[str] = set()
         self.committed = False
+        # two-frontier bookkeeping (Config.order_then_settle): the
+        # ciphertext ordering is durable / this node's dec shares went
+        # out / the trace clock at ordering (decrypt_lag span start)
+        self.ordered = False
+        self.shares_issued = False
+        self.t_ordered = 0.0
 
 
 class _CountingBroadcaster:
@@ -461,6 +481,21 @@ class HoneyBadger:
             outward, self.metrics, len(self.members)
         )
         self._epochs: Dict[int, _EpochState] = {}
+        # epoch -> COrd body bytes for every epoch this node ORDERED
+        # (locally or via COrd catch-up): the ordered CATCHUP serving
+        # store and the cross-node byte-identity invariant's witness.
+        # Epochs adopted via plaintext catch-up alone have no entry;
+        # entries one serving window behind the settled frontier are
+        # pruned (_advance_epoch), bounding the store.
+        self._ordered_bodies: Dict[int, bytes] = {}
+        # settler reentrancy guard (settling starts the next epoch,
+        # whose turn exit would recurse into the settler) and the
+        # one-instant-per-parked-epoch trace dedup
+        self._settler_active = False
+        self._park_traced = -1
+        self.metrics.set_frontiers(
+            lambda: (self.epoch, len(self.committed_batches))
+        )
         # production: unpredictable sampling (censorship resistance);
         # seeded: reproducible for tests (config.seed docs).  The
         # seed-vs-SystemRandom fork lives in ONE audited helper
@@ -492,10 +527,36 @@ class HoneyBadger:
                 if epoch > ckpt_epoch:
                     self._remember_committed(set(batch.tx_list()))
             self.epoch = batch_log.last_epoch + 1
+        if (
+            self._two_frontier
+            and batch_log is not None
+            and batch_log.last_ordered_epoch is not None
+        ):
+            # ordered-ahead epochs (COrd records with no CLOG yet — a
+            # crash landed between order and settle): re-enter them
+            # into the settler as settle-only states.  The ordering is
+            # NEVER re-run; the plaintext arrives via the re-issued
+            # dec-share exchange (every restarted node re-broadcasts
+            # its own shares from the settler) and/or CLOG catch-up
+            # from peers that already settled.
+            for oepoch, body in batch_log.replay_ordered():
+                if oepoch < self.epoch:
+                    continue  # its CLOG follows in the log: settled
+                _e, output = decode_ordered_body(body)
+                es = _EpochState(None)
+                es.proposed = True
+                es.output = output
+                es.ordered = True
+                self._epochs[oepoch] = es
+                self._ordered_bodies[oepoch] = body
+                self.epoch = oepoch + 1
         # CATCHUP: epoch -> sender -> response body.  Epochs adopt in
         # order at the commit frontier, each on f+1 identical bodies
         # (>= 1 honest sender => the true committed batch).
         self._catchup_tallies: Dict[int, Dict[str, bytes]] = {}
+        # ordered-frontier CATCHUP tallies (COrd bodies), the
+        # two-frontier twin of the plaintext tallies above
+        self._catchup_ord_tallies: Dict[int, Dict[str, bytes]] = {}
         self._last_catchup_request: Optional[int] = None
         self._farahead_sightings = 0
         # serving-side guard state (all counted, never clocked):
@@ -506,6 +567,14 @@ class HoneyBadger:
         self._catchup_floor: Dict[str, int] = {}
         self._catchup_repeats: Dict[str, int] = {}
         self._catchup_last_req: Dict[str, int] = {}
+        # sender -> (next_epoch, limit): plaintext continuation owed
+        # after a window we could only answer with COrd bodies (the
+        # epochs were ordered here but not yet settled).  Pushed as we
+        # settle — the requester's repeat budget is spent by then and
+        # budgets re-arm only on ordering advances, so without the
+        # push a quiescent cluster wedges.  ``limit`` is fixed at
+        # serve time, so one request never buys an unbounded stream.
+        self._catchup_plain_owed: Dict[str, Tuple[int, int]] = {}
 
     def _remember_committed(self, seen: Set[bytes]) -> None:
         """Fold one epoch's committed txs into the bounded duplicate
@@ -555,6 +624,35 @@ class HoneyBadger:
 
     def pending_tx_count(self) -> int:
         return len(self.que)
+
+    @property
+    def _two_frontier(self) -> bool:
+        """Two-frontier commit (Config.order_then_settle): self.epoch
+        is the ORDERED frontier (the epoch the live protocol runs in);
+        the SETTLED frontier is len(self.committed_batches) — plain-
+        text durable, dedup applied, on_commit fired.  The split is
+        the epoch-pipelining mechanism upgraded, so the
+        ``epoch_pipelining=False`` strict-sequencing diagnostic arm
+        keeps its meaning: with pipelining off, commit stays coupled.
+        A property (not cached) because tests toggle both flags on a
+        constructed node."""
+        cfg = self.config
+        return cfg.order_then_settle and cfg.epoch_pipelining
+
+    @property
+    def settled_epoch(self) -> int:
+        """The SETTLED frontier: epochs whose plaintext batch is
+        durable, dedup-filtered and delivered (on_commit).  Equal to
+        the ordered frontier ``self.epoch`` on the coupled path; at
+        most Config.decrypt_lag_max behind it in two-frontier mode."""
+        return len(self.committed_batches)
+
+    def ordered_record(self, epoch: int) -> Optional[bytes]:
+        """The COrd body this node ordered for ``epoch`` (None when the
+        epoch arrived via plaintext catch-up without ever ordering
+        locally) — the bytes the cross-node byte-identity invariant
+        compares and ordered CATCHUP serves."""
+        return self._ordered_bodies.get(epoch)
 
     # -- batch policy (reference honeybadger.go:62-104) --------------------
 
@@ -619,6 +717,12 @@ class HoneyBadger:
             tr.instant("transport", "wave", msgs=self._trace_wave_msgs)
             self._trace_wave_msgs = 0
         self._drain_coin_issues()
+        # the trailing settler (two-frontier mode) runs HERE, off the
+        # ordered critical path: issue pending dec shares, probe
+        # combines, settle ready epochs in order.  It runs before the
+        # hub flush so any CP-verification work it requests rides this
+        # wave's batched dispatch, not the next one's.
+        self._drive_settler()
         self.hub.run_deferred()
         # the flush itself can advance rounds and queue NEW coin
         # issues (coin reveal -> advance -> next round's aux quorum);
@@ -633,6 +737,7 @@ class HoneyBadger:
         would otherwise strand the turn's messages)."""
         if not self._transport_managed:
             self._drain_coin_issues()
+            self._drive_settler()
             self._coalesce.flush()
 
     def _queue_coin_issue(self, bba, rnd: int) -> None:
@@ -707,6 +812,9 @@ class HoneyBadger:
         if pcls is CatchupRespPayload:
             self._handle_catchup_resp(sender_id, payload)
             return
+        if pcls is CatchupOrdPayload:
+            self._handle_catchup_ord(sender_id, payload)
+            return
         epoch = getattr(payload, "epoch", None)
         if epoch is None:
             return
@@ -740,6 +848,11 @@ class HoneyBadger:
             self._handle_dec_share_batch(epoch, es, sender_id, payload)
             return
         if cls in _ACS_PAYLOADS:
+            if es.acs is None:
+                # settle-only state (two-frontier mode: the ordering
+                # is already durable) — consensus traffic for it is
+                # stale by definition, only dec shares still matter
+                return
             # follow the epoch: a peer is running it, so contribute our
             # (possibly empty) proposal too — every correct node must
             # propose or ACS never reaches n-f ones
@@ -799,6 +912,14 @@ class HoneyBadger:
             tr.instant(
                 "epoch", "acs_output", epoch=epoch, proposers=len(output)
             )
+        if self._two_frontier:
+            # Two-frontier split: commit the CIPHERTEXT ordering now
+            # (WAL-durable, frontier advance — epoch e+1's RBC/BBA
+            # starts immediately); the whole TPKE dec-share exchange
+            # trails in the settler at the transports' idle callbacks.
+            self._maybe_order()
+            return
+        # -- coupled arm (Config.order_then_settle=False) ----------------
         # Epoch pipelining (BASELINE config 5): this epoch has entered
         # its decryption-share phase — overlap it with the NEXT epoch's
         # proposal (RS encode + Merkle forest + VAL/ECHO round trips).
@@ -809,12 +930,30 @@ class HoneyBadger:
             and len(self.que) > 0
         ):
             self.start_epoch(epoch + 1)
-        # span start AFTER the pipelined next-epoch proposal: the
+        # share issue AFTER the pipelined next-epoch proposal: the
         # share-issue stage must not absorb epoch e+1's encode time
+        self._issue_dec_shares(epoch, es)
+        for proposer in list(es.ciphertexts):
+            self._try_decrypt(epoch, es, proposer)
+        self._maybe_commit(epoch, es)
+
+    def _issue_dec_shares(self, epoch: int, es: _EpochState) -> None:
+        """Parse the agreed ciphertexts and broadcast this node's
+        decryption share for each — ALL of the epoch's shares in ONE
+        batched exponentiation dispatch (and one CP-nonce entropy
+        draw).  The coupled path runs this at ACS output, on the
+        commit critical path; in two-frontier mode the settler runs it
+        off the ordered frontier at an idle boundary."""
+        if es.shares_issued or es.output is None:
+            return
+        es.shares_issued = True
+        tr = self.trace
         t_share0 = 0.0 if tr is None else tr.now()
         issue_cts = []
         issue_proposers = []
-        for proposer, ct_bytes in output.items():
+        for proposer, ct_bytes in es.output.items():
+            if proposer in es.ciphertexts or proposer in es.decrypted:
+                continue
             try:
                 ct = deserialize_ciphertext(
                     ct_bytes, self.keys.tpke_pub.group
@@ -827,10 +966,6 @@ class HoneyBadger:
             es.ciphertexts[proposer] = ct
             issue_cts.append(ct)
             issue_proposers.append(proposer)
-        # ALL of the epoch's decryption shares issue in ONE batched
-        # exponentiation dispatch (and one CP-nonce entropy draw) —
-        # per-proposer tpke.dec_share was N scalar 4-exp calls plus N
-        # urandom reads per node per epoch on the commit critical path
         dec_shares = self.tpke.dec_share_batch(
             self.keys.tpke_share, issue_cts
         )
@@ -847,15 +982,128 @@ class HoneyBadger:
             )
         if tr is not None:
             tr.complete(
-                "tpke",
+                # the settler runs this off the ordered critical path
+                # in two-frontier mode: its mass belongs to the settle
+                # track, not the open->ordered window's tpke share
+                "settle" if self._two_frontier else "tpke",
                 "dec_share_issue",
                 t_share0,
                 epoch=epoch,
                 ciphertexts=len(es.ciphertexts),
             )
-        for proposer in list(es.ciphertexts):
-            self._try_decrypt(epoch, es, proposer)
-        self._maybe_commit(epoch, es)
+
+    # -- the ordered frontier (two-frontier mode) --------------------------
+
+    def _maybe_order(self) -> None:
+        """Advance the ORDERED frontier: the moment the current
+        epoch's ACS output is agreed, durably commit the ciphertext
+        ordering (COrd record) and open the next epoch — without
+        waiting for the decryption exchange.  Parks while the settled
+        frontier trails by Config.decrypt_lag_max epochs, so a
+        coalition delaying settlement (share forgery) stalls ordering
+        AT the bound instead of letting the durable-plaintext lag grow
+        without limit."""
+        while True:
+            es = self._epochs.get(self.epoch)
+            if es is None or es.output is None or es.ordered:
+                return
+            epoch = self.epoch
+            lag = epoch - len(self.committed_batches)
+            if lag >= self.config.decrypt_lag_max:
+                if (
+                    self.trace is not None
+                    and self._park_traced != epoch
+                ):
+                    self._park_traced = epoch
+                    self.trace.instant(
+                        "epoch", "order_parked", epoch=epoch, lag=lag
+                    )
+                return
+            self._record_ordered(epoch, es)
+            if self.trace is not None:
+                self.trace.instant(
+                    "epoch",
+                    "ordered",
+                    epoch=epoch,
+                    proposers=len(es.output),
+                )
+            self.log.debug("ordered", epoch=epoch)
+            self._advance_epoch()
+
+    def _record_ordered(
+        self,
+        epoch: int,
+        es: _EpochState,
+        body: Optional[bytes] = None,
+    ) -> None:
+        """The ordered-frontier bookkeeping shared by the local path
+        and COrd catch-up adoption: ONE body is the durable WAL
+        record, the catch-up serving store, and the fuzzer's
+        byte-identity witness — pass the adopted quorum bytes when
+        they exist, or the canonical encoding of ``es.output`` is
+        used."""
+        if body is None:
+            body = encode_ordered_body(epoch, es.output)
+        es.ordered = True
+        tr = self.trace
+        es.t_ordered = 0.0 if tr is None else tr.now()
+        if self.batch_log is not None:
+            self.batch_log.append_ordered_body(epoch, body)
+        self._ordered_bodies[epoch] = body
+        self.metrics.epoch_ordered(epoch)
+
+    def _drive_settler(self) -> None:
+        """The trailing settle track: issue pending dec shares for
+        ordered epochs, probe combines, and settle ready epochs
+        strictly in order — all OFF the ordered frontier's critical
+        path (runs at the transports' idle callbacks, and at turn exit
+        on self-draining transports).  Reentrancy-guarded: settling an
+        epoch can start the next one, whose turn exit recurses here."""
+        if not self._two_frontier or self._settler_active:
+            return
+        self._settler_active = True
+        try:
+            for epoch in range(len(self.committed_batches), self.epoch):
+                es = self._epochs.get(epoch)
+                if es is None or not es.ordered:
+                    continue
+                if not es.shares_issued:
+                    self._issue_dec_shares(epoch, es)
+                for proposer in list(es.ciphertexts):
+                    if proposer not in es.decrypted:
+                        self._try_decrypt(epoch, es, proposer)
+            self._maybe_settle()
+        finally:
+            self._settler_active = False
+
+    def _maybe_settle(self) -> None:
+        """Settle ordered epochs in order at the SETTLED frontier:
+        write the plaintext CLOG record, apply the dedup filter, fire
+        on_commit.  Each settlement may unlock the next epoch's
+        already-complete decryption — and releases backpressure on the
+        ordered frontier."""
+        while True:
+            epoch = len(self.committed_batches)
+            if epoch >= self.epoch:
+                return  # nothing ordered ahead of settlement
+            es = self._epochs.get(epoch)
+            if (
+                es is None
+                or not es.ordered
+                or es.committed
+                or es.output is None
+                or any(p not in es.decrypted for p in es.output)
+            ):
+                return
+            self._commit_batch(epoch, es)
+            self._prune_epoch_states()
+            # settling may release backpressure: resume ordering (and
+            # with it, proposing) the moment lag drops below the bound
+            # — on BOTH ordering paths, or a catch-up node parked at
+            # the bound with a full f+1 COrd tally wedges in a
+            # quiescent cluster
+            self._maybe_order()
+            self._maybe_adopt_ordered()
 
     def _handle_dec_share(
         self,
@@ -880,6 +1128,11 @@ class HoneyBadger:
         if not pool.add_lazy(sender, index, d, e, z):
             self.metrics.dedup_absorbed.inc()
             return
+        if self._two_frontier:
+            # shares only POOL on the message path; the settler probes
+            # combines and settles at the next idle boundary, so the
+            # decrypt work batches per wave instead of per frame
+            return
         self._try_decrypt(epoch, es, proposer)
         self._maybe_commit(epoch, es)
 
@@ -902,6 +1155,7 @@ class HoneyBadger:
         threshold = self.keys.tpke_pub.threshold
         dcol, ecol, zcol = payload.d, payload.e, payload.z
         opt_failed = es.opt_failed
+        probe = not self._two_frontier  # two-frontier: settler probes
         touched = []
         for i, proposer in enumerate(payload.proposers):
             if proposer not in member:
@@ -910,6 +1164,8 @@ class HoneyBadger:
             if pool is None:
                 pool = pools.setdefault(proposer, SharePool(threshold))
             if pool.add_lazy(sender, index, dcol[i], ecol[i], zcol[i]):
+                if not probe:
+                    continue
                 # decrypt probes only on the threshold CROSSING (below
                 # it nothing can combine; above it the only consumers
                 # of fresh shares are a flagged pool needing CP-path
@@ -970,7 +1226,11 @@ class HoneyBadger:
                 return
             if tr is not None:
                 tr.complete(
-                    "tpke", "combine", t0, epoch=epoch, proposer=proposer
+                    "settle" if self._two_frontier else "tpke",
+                    "combine",
+                    t0,
+                    epoch=epoch,
+                    proposer=proposer,
                 )
             try:
                 es.decrypted[proposer] = deserialize_txs(
@@ -1063,12 +1323,17 @@ class HoneyBadger:
             self._exit_turn()
 
     def _request_catchup(self, force: bool = False) -> None:
-        if not force and self._last_catchup_request == self.epoch:
+        # the SETTLED frontier is what we are missing durably; peers
+        # answer with CLOG bodies from there plus (two-frontier mode)
+        # COrd bodies up to their ordered frontier.  On the coupled
+        # path settled == self.epoch, the historical behavior.
+        frontier = len(self.committed_batches)
+        if not force and self._last_catchup_request == frontier:
             return  # one broadcast per frontier (re-fired as we adopt)
-        self._last_catchup_request = self.epoch
+        self._last_catchup_request = frontier
         if self.trace is not None:
-            self.trace.instant("catchup", "request", from_epoch=self.epoch)
-        self.out.broadcast(CatchupReqPayload(from_epoch=self.epoch))
+            self.trace.instant("catchup", "request", from_epoch=frontier)
+        self.out.broadcast(CatchupReqPayload(from_epoch=frontier))
 
     def _handle_catchup_req(
         self, sender: str, p: CatchupReqPayload
@@ -1080,8 +1345,24 @@ class HoneyBadger:
         # heals later, peer_reconnected re-serves from here
         self._catchup_last_req[sender] = start
         end = min(len(self.committed_batches), start + CATCHUP_MAX_EPOCHS)
-        if not (0 <= start < end):
+        # two-frontier mode: epochs we ORDERED but have not settled yet
+        # have no plaintext to serve, but their agreed ciphertext
+        # ordering (COrd body) still lets the requester advance its
+        # ordered frontier and rejoin the live epochs
+        ord_start = max(start, len(self.committed_batches))
+        ord_end = (
+            min(self.epoch, start + CATCHUP_MAX_EPOCHS)
+            if self._two_frontier
+            else 0
+        )
+        serve_ord = [
+            e
+            for e in range(ord_start, ord_end)
+            if e in self._ordered_bodies
+        ]
+        if not (0 <= start < end) and not serve_ord:
             return  # nothing committed there (yet) that we can serve
+        end = max(end, start)  # plaintext range may be empty
         # amplification guard: a legitimately catching-up node's
         # from_epoch strictly advances past each window we served it;
         # a request that does NOT advance (replayed frame, Byzantine
@@ -1098,16 +1379,75 @@ class HoneyBadger:
                 return
             self._catchup_repeats[sender] = budget - 1
         self._catchup_floor[sender] = max(
-            self._catchup_floor.get(sender, 0), end
+            self._catchup_floor.get(sender, 0), end, ord_end
         )
-        from cleisthenes_tpu.core.ledger import encode_batch_body
-
         if self.trace is not None:
             self.trace.instant(
-                "catchup", "serve", from_epoch=start, epochs=end - start
+                "catchup",
+                "serve",
+                from_epoch=start,
+                epochs=max(0, end - start),
+                ordered=len(serve_ord),
             )
         # one response per missed epoch; the coalescing broadcaster
         # bundles the run into a single envelope for the requester
+        self._send_clog_range(sender, start, end)
+        for epoch in serve_ord:
+            self.out.send_to(
+                sender,
+                CatchupOrdPayload(
+                    epoch=epoch, body=self._ordered_bodies[epoch]
+                ),
+            )
+        if serve_ord:
+            # part of the window went out as ciphertext orderings
+            # only: owe the requester those epochs' plaintext, pushed
+            # from _serve_owed_plaintext as settlement reaches them
+            self._catchup_plain_owed[sender] = (
+                end,
+                serve_ord[-1] + 1,
+            )
+
+    def _serve_owed_plaintext(self) -> None:
+        """Settlement made new plaintext servable: push the CLOG
+        bodies owed to requesters whose last window we could only
+        answer with COrd bodies.  By the time we settle, such a
+        requester's repeat budget is typically spent and budgets
+        re-arm only on ORDERING advances — without this push a
+        quiescent cluster wedges with the requester parked at the
+        decrypt-lag bound.  Bounded by the limit fixed at serve time:
+        each request buys at most its own window, once as COrd and
+        once as CLOG."""
+        if not self._catchup_plain_owed:
+            return
+        settled = len(self.committed_batches)
+        for sender, (nxt, limit) in list(
+            self._catchup_plain_owed.items()
+        ):
+            end = min(settled, limit)
+            if nxt >= end:
+                if nxt >= limit:
+                    del self._catchup_plain_owed[sender]
+                continue
+            if self.trace is not None:
+                self.trace.instant(
+                    "catchup",
+                    "serve_settled",
+                    from_epoch=nxt,
+                    epochs=end - nxt,
+                )
+            self._send_clog_range(sender, nxt, end)
+            if end >= limit:
+                del self._catchup_plain_owed[sender]
+            else:
+                self._catchup_plain_owed[sender] = (end, limit)
+
+    def _send_clog_range(
+        self, sender: str, start: int, end: int
+    ) -> None:
+        """One CatchupResp per committed epoch in [start, end) — the
+        serve loop shared by direct catch-up answers and the
+        owed-plaintext push."""
         for epoch in range(start, end):
             self.out.send_to(
                 sender,
@@ -1134,7 +1474,10 @@ class HoneyBadger:
                 return
             self._catchup_repeats.pop(member_id, None)
             last = self._catchup_last_req.get(member_id)
-            if last is not None and last < len(self.committed_batches):
+            servable = len(self.committed_batches)
+            if self._two_frontier:
+                servable = max(servable, self.epoch)  # COrd bodies too
+            if last is not None and last < servable:
                 self._catchup_floor.pop(member_id, None)
                 self._handle_catchup_req(
                     member_id, CatchupReqPayload(from_epoch=last)
@@ -1142,12 +1485,43 @@ class HoneyBadger:
         finally:
             self._exit_turn()
 
+    def _tally_winner(self, tally, expected_epoch, decode):
+        """The shared f+1 quorum rule of BOTH catch-up planes
+        (plaintext CLOG and ordered COrd bodies): pick the most-voted
+        body; below f+1 votes nothing adopts.  An f+1 quorum always
+        contains an honest sender, so a winning body that fails
+        ``decode`` / claims the wrong epoch is pure-Byzantine — shed
+        its votes and re-tally.  Returns (decoded_value, body) or
+        None; sheds mutate ``tally`` in place."""
+        while tally:
+            counts: Dict[bytes, int] = {}
+            for body in tally.values():
+                counts[body] = counts.get(body, 0) + 1
+            body, votes = max(counts.items(), key=lambda kv: kv[1])
+            if votes < self.config.f + 1:
+                return None
+            try:
+                epoch, decoded = decode(body)
+            except (ValueError, struct.error, UnicodeDecodeError):
+                epoch = decoded = None
+            if epoch != expected_epoch:
+                for snd in [s for s, b in tally.items() if b == body]:
+                    del tally[snd]
+                continue
+            return decoded, body
+        return None
+
     def _handle_catchup_resp(
         self, sender: str, p: CatchupRespPayload
     ) -> None:
         if sender not in self._member_set:
             return
-        if not (self.epoch <= p.epoch < self.epoch + CATCHUP_WINDOW):
+        # plaintext adoption happens at the SETTLED frontier (== the
+        # live frontier on the coupled path); in two-frontier mode an
+        # ordered-ahead node accepts CLOG bodies for epochs it ordered
+        # but could not settle (e.g. a restart lost its peers' shares)
+        frontier = len(self.committed_batches)
+        if not (frontier <= p.epoch < frontier + CATCHUP_WINDOW):
             return  # stale, or absurdly far ahead: bound tally memory
         # one vote per (epoch, sender); a re-send overwrites, never adds
         self._catchup_tallies.setdefault(p.epoch, {})[sender] = p.body
@@ -1155,29 +1529,15 @@ class HoneyBadger:
         # adopt in epoch order at the frontier; each adoption may
         # unlock the NEXT epoch's already-collected quorum
         while True:
-            tally = self._catchup_tallies.get(self.epoch)
+            frontier = len(self.committed_batches)
+            tally = self._catchup_tallies.get(frontier)
             if not tally:
                 break
-            counts: Dict[bytes, int] = {}
-            for body in tally.values():
-                counts[body] = counts.get(body, 0) + 1
-            body, votes = max(counts.items(), key=lambda kv: kv[1])
-            if votes < self.config.f + 1:
+            won = self._tally_winner(tally, frontier, decode_batch_body)
+            if won is None:
                 break
-            from cleisthenes_tpu.core.ledger import decode_batch_body
-
-            try:
-                epoch, batch = decode_batch_body(body)
-            except (ValueError, struct.error, UnicodeDecodeError):
-                epoch = None
-            if epoch != self.epoch:
-                # an f+1 quorum always contains an honest sender, so a
-                # winning body that fails decode / claims the wrong
-                # epoch is pure-Byzantine: shed its votes and re-tally
-                for snd in [s for s, b in tally.items() if b == body]:
-                    del tally[snd]
-                continue
-            self._adopt_catchup_batch(epoch, batch)
+            batch, _body = won
+            self._adopt_catchup_batch(frontier, batch)
             adopted = True
         if adopted:
             # the frontier moved: peers may hold more epochs than one
@@ -1207,8 +1567,90 @@ class HoneyBadger:
         self._epochs.pop(epoch, None)  # any partial local state is moot
         self.hub.drop_scope((self.node_id, epoch))
         self._catchup_tallies.pop(epoch, None)
+        self._serve_owed_plaintext()
         if self.on_commit is not None:
             self.on_commit(epoch, batch)
+        if self._two_frontier and epoch < self.epoch:
+            # plaintext for an epoch we had already ORDERED (restart
+            # with an ordered-ahead window, or a settle stall peers
+            # resolved first): the settled frontier advanced; the live
+            # frontier is already past.  The next ordered epoch may be
+            # ready, and settling may release ordering backpressure.
+            self._catchup_ord_tallies.pop(epoch, None)
+            self._maybe_settle()
+            self._maybe_order()
+            self._maybe_adopt_ordered()
+            return
+        self._advance_epoch()
+        if self._two_frontier:
+            self._maybe_order()  # a buffered ACS output may be next
+
+    # -- ordered-frontier CATCHUP (two-frontier mode) ----------------------
+
+    def _handle_catchup_ord(
+        self, sender: str, p: CatchupOrdPayload
+    ) -> None:
+        if sender not in self._member_set or not self._two_frontier:
+            return
+        if not (self.epoch <= p.epoch < self.epoch + CATCHUP_WINDOW):
+            return  # stale, or absurdly far ahead: bound tally memory
+        self._catchup_ord_tallies.setdefault(p.epoch, {})[sender] = p.body
+        self._maybe_adopt_ordered()
+
+    def _maybe_adopt_ordered(self) -> None:
+        """Adopt ciphertext orderings learned via COrd catch-up, in
+        order at the ORDERED frontier, each on f+1 byte-identical
+        bodies (>= 1 honest sender => the agreed ACS output) — the
+        exact adoption rule of the plaintext path, one frontier up.
+        Backpressure applies the same way: adopted ordered-ahead
+        epochs are bounded by Config.decrypt_lag_max."""
+        adopted = False
+        while True:
+            if (
+                self.epoch - len(self.committed_batches)
+                >= self.config.decrypt_lag_max
+            ):
+                break  # the settler must drain before we order ahead
+            tally = self._catchup_ord_tallies.get(self.epoch)
+            if not tally:
+                break
+            won = self._tally_winner(
+                tally, self.epoch, decode_ordered_body
+            )
+            if won is None:
+                break
+            output, body = won
+            self._adopt_ordered(self.epoch, output, body)
+            adopted = True
+        if adopted:
+            # chase the rest (plaintext AND ordered) from the peers.
+            # Forced: COrd adoption advances the ORDERED frontier only,
+            # and the non-forced dedup keys on the settled frontier —
+            # without force this chase would be a no-op until
+            # settlement moves (peers' counted repeat budgets still
+            # bound a stuck requester)
+            self._request_catchup(force=True)
+
+    def _adopt_ordered(
+        self, epoch: int, output: Dict[str, bytes], body: bytes
+    ) -> None:
+        """One ordering adopted: durable COrd record, bookkeeping,
+        frontier advance.  The settler decrypts it like any locally
+        ordered epoch — our own dec share re-issues at the next idle
+        boundary; the plaintext typically completes via the share
+        exchange or CLOG catch-up once peers settle."""
+        self.log.info("adopted catch-up ordering", epoch=epoch)
+        if self.trace is not None:
+            self.trace.instant("catchup", "adopt_ordered", epoch=epoch)
+        es = self._epochs.get(epoch)
+        if es is None:
+            es = _EpochState(None)
+            es.proposed = True
+            self._epochs[epoch] = es
+        if es.output is None:
+            es.output = output
+        self._record_ordered(epoch, es, body)
+        self._catchup_ord_tallies.pop(epoch, None)
         self._advance_epoch()
 
     def _maybe_log_checkpoint(self, epoch: int) -> None:
@@ -1228,10 +1670,24 @@ class HoneyBadger:
     # -- commit (the consensused batch of honeybadger.go:20-21) ------------
 
     def _maybe_commit(self, epoch: int, es: _EpochState) -> None:
+        if self._two_frontier:
+            # decryption progress feeds the SETTLED frontier; the
+            # ordered frontier advanced at ACS output
+            self._maybe_settle()
+            return
         if es.committed or es.output is None or epoch != self.epoch:
             return
         if any(p not in es.decrypted for p in es.output):
             return
+        self._commit_batch(epoch, es)
+        self._advance_epoch()
+
+    def _commit_batch(self, epoch: int, es: _EpochState) -> None:
+        """Deliver one fully-decrypted epoch: build the deduped batch,
+        append the plaintext CLOG record, fold the dedup filter, fire
+        on_commit.  The coupled path runs this at the (single) commit
+        frontier; two-frontier mode runs it at the settled frontier,
+        strictly in epoch order."""
         es.committed = True
         seen: Set[bytes] = set()
         contributions: Dict[str, List[bytes]] = {}
@@ -1253,6 +1709,13 @@ class HoneyBadger:
             self.trace.instant(
                 "epoch", "commit", epoch=epoch, txs=len(batch)
             )
+            if es.t_ordered:
+                # the settle track made visible: one span from the
+                # ciphertext-ordered commit to plaintext settlement —
+                # the tpke mass that LEFT the open->ordered window
+                self.trace.complete(
+                    "settle", "decrypt_lag", es.t_ordered, epoch=epoch
+                )
         if self.batch_log is not None:
             self.batch_log.append(epoch, batch)
         self.log.debug("committed", epoch=epoch, txs=len(batch))
@@ -1268,29 +1731,67 @@ class HoneyBadger:
             self._maybe_log_checkpoint(epoch)
         if self.on_commit is not None:
             self.on_commit(epoch, batch)
-        self._advance_epoch()
+        self._serve_owed_plaintext()
+
+    def _prune_epoch_states(self) -> None:
+        """Drop epoch state that is BOTH outside the demux window
+        (late frames for it are rejected by ``_epoch_state``, so the
+        state can never be touched again) and — in two-frontier mode
+        — settled (an ordered-but-unsettled epoch must stay live
+        however far the ordered frontier runs; its share exchange and
+        settlement are still pending).  Driven from ordering advances
+        AND from settlement: a quiescing two-frontier node settles
+        its last ``decrypt_lag_max`` epochs with no further ordering,
+        and must not retain their ACS/share state indefinitely."""
+        settled = len(self.committed_batches)
+        for stale in [
+            e
+            for e in self._epochs
+            if e < self.epoch - KEEP_BEHIND
+            and (not self._two_frontier or e < settled)
+        ]:
+            del self._epochs[stale]
+            self.hub.drop_scope((self.node_id, stale))
 
     def _advance_epoch(self) -> None:
+        """Advance the live-protocol frontier ``self.epoch``: at every
+        commit on the coupled path, at every ORDERING in two-frontier
+        mode (where commit = settle trails behind)."""
         self.epoch += 1
+        settled = len(self.committed_batches)
         for stale in [  # tallies below the frontier can never adopt
-            e for e in self._catchup_tallies if e < self.epoch
+            e for e in self._catchup_tallies if e < settled
         ]:
             del self._catchup_tallies[stale]
+        for stale in [
+            e for e in self._catchup_ord_tallies if e < self.epoch
+        ]:
+            del self._catchup_ord_tallies[stale]
+        for stale in [
+            # COrd catch-up only ever serves from the settled frontier
+            # up; bodies further behind are diagnostic witnesses (the
+            # fuzzer's cross-node byte-identity check), kept for one
+            # serving window, never forever
+            e
+            for e in self._ordered_bodies
+            if e < settled - CATCHUP_MAX_EPOCHS
+        ]:
+            del self._ordered_bodies[stale]
         # progress re-arms the catch-up serving budgets and the
         # far-ahead retry clock (both counted per frontier value)
         self._catchup_repeats.clear()
         self._farahead_sightings = 0
-        for stale in [
-            e for e in self._epochs if e < self.epoch - KEEP_BEHIND
-        ]:
-            del self._epochs[stale]
-            self.hub.drop_scope((self.node_id, stale))
+        self._prune_epoch_states()
         # propose into the new epoch if we have work, or if peers
         # already started it (its state exists from buffered traffic)
         if self.auto_propose and (
             len(self.que) > 0 or self.epoch in self._epochs
         ):
             self.start_epoch()
+        if self._two_frontier:
+            # the _maybe_order loop picks up the next epoch's buffered
+            # ACS output; settlement is the settler's business
+            return
         # the new current epoch may have fully resolved while we were
         # still committing the previous one
         es = self._epochs.get(self.epoch)

@@ -319,6 +319,7 @@ class ValidatorHost:
             queue_depth_limit=config.slo_queue_depth,
             peer_lag_epochs=config.slo_peer_lag_epochs,
             peer_states_fn=self._peer_states,
+            decrypt_lag_budget=config.decrypt_lag_max,
             trace=self.node.trace,
         )
         self.node.metrics.set_alerts(self.watchdog.alerts_block)

@@ -152,6 +152,21 @@ class CatchupRespPayload(NamedTuple):
     body: bytes
 
 
+class CatchupOrdPayload(NamedTuple):
+    """One peer's ciphertext-ORDERED commit for ``epoch`` (COrd body
+    bytes, core.ledger.encode_ordered_body) — the two-frontier twin of
+    CatchupRespPayload (Config.order_then_settle).  A peer that has
+    ordered but not yet settled an epoch cannot serve its plaintext,
+    but CAN serve the agreed ciphertext ordering, so a lagging node
+    advances its ordered frontier (and rejoins live epochs) without
+    waiting for the roster's trailing decryption.  Adoption mirrors
+    the CLOG rule: f+1 byte-identical bodies, in order, at the
+    adopter's ORDERED frontier."""
+
+    epoch: int
+    body: bytes
+
+
 class BundlePayload(NamedTuple):
     """Several protocol payloads in ONE authenticated envelope.
 
@@ -249,6 +264,7 @@ Payload = Union[
     DecSharePayload,
     CatchupReqPayload,
     CatchupRespPayload,
+    CatchupOrdPayload,
     BundlePayload,
     BbaBatchPayload,
     CoinBatchPayload,
@@ -271,6 +287,7 @@ _KIND_COIN_BATCH = 11
 _KIND_DEC_BATCH = 12
 _KIND_READY_BATCH = 13
 _KIND_ECHO_BATCH = 14
+_KIND_CATCHUP_ORD = 15
 
 # DoS bound on per-instance columns (a roster is <= 256 under the
 # GF(2^8) shard cap; 4096 leaves margin for multi-round merges)
@@ -416,6 +433,10 @@ def _encode_payload(p: Payload) -> Tuple[int, bytes]:
         out.append(struct.pack(">Q", p.epoch))
         _pack_bytes(out, p.body)
         return _KIND_CATCHUP_RESP, b"".join(out)
+    if isinstance(p, CatchupOrdPayload):
+        out.append(struct.pack(">Q", p.epoch))
+        _pack_bytes(out, p.body)
+        return _KIND_CATCHUP_ORD, b"".join(out)
     if isinstance(p, BundlePayload):
         if len(p.items) > MAX_BUNDLE_ITEMS:
             raise ValueError(f"bundle of {len(p.items)} items exceeds cap")
@@ -714,6 +735,12 @@ def _parse_payload(d: bytes, o: int, end: int, kind: int):
         (epoch,) = _U64.unpack_from(d, o)
         body, o = _field(d, o + 8, end)
         return CatchupRespPayload(epoch, body), o
+    if kind == _KIND_CATCHUP_ORD:
+        if o + 8 > end:
+            raise ValueError("truncated frame")
+        (epoch,) = _U64.unpack_from(d, o)
+        body, o = _field(d, o + 8, end)
+        return CatchupOrdPayload(epoch, body), o
     if kind == _KIND_BUNDLE:
         if o + 4 > end:
             raise ValueError("truncated frame")
@@ -850,6 +877,7 @@ __all__ = [
     "DecSharePayload",
     "CatchupReqPayload",
     "CatchupRespPayload",
+    "CatchupOrdPayload",
     "BundlePayload",
     "BbaBatchPayload",
     "CoinBatchPayload",

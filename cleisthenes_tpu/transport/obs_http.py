@@ -177,8 +177,32 @@ def render_prometheus(targets: Sequence[ObsTarget]) -> str:
              "propose -> ACS output wall time per epoch"),
             ("decrypt_latency_seconds", m.decrypt_latency,
              "ACS output -> commit (threshold decryption) per epoch"),
+            ("ordered_latency_seconds", m.ordered_latency,
+             "propose -> ciphertext-ordered commit (two-frontier "
+             "ordered frontier)"),
+            ("settle_lag_seconds", m.settle_lag_latency,
+             "ordered -> settled (trailing decrypt frontier lag)"),
         ):
             _expose_histogram(exp, hname, help_text, hist, labels)
+        frontiers = snap["frontiers"]
+        exp.add(
+            exp.family(
+                "epochs_ordered_total", "counter",
+                "epochs whose ciphertext ordering committed "
+                "(two-frontier commit split)",
+            ),
+            labels,
+            int(frontiers["epochs_ordered"]),
+        )
+        exp.add(
+            exp.family(
+                "decrypt_lag_epochs", "gauge",
+                "ordered frontier - settled frontier (0 on the "
+                "coupled path; bounded by decrypt_lag_max)",
+            ),
+            labels,
+            int(frontiers["decrypt_lag_epochs"]),
+        )
         transport = snap["transport"]
         frames = exp.family(
             "transport_frames_total", "counter",

@@ -32,11 +32,13 @@ from typing import Optional, Tuple
 
 from cleisthenes_tpu.transport.message import (
     BbaPayload,
+    CatchupOrdPayload,
     CatchupReqPayload,
     CatchupRespPayload,
     Message,
     Payload,
     RbcPayload,
+    _KIND_CATCHUP_ORD,
     _KIND_CATCHUP_REQ,
     _KIND_CATCHUP_RESP,
     _encode_payload,
@@ -54,6 +56,9 @@ _WT_LEN = 2
 # a reference peer simply cannot serve catch-up.
 _PB_TAG_CATCHUP_REQ = 15
 _PB_TAG_CATCHUP_RESP = 16
+# ciphertext-ordered catch-up (Config.order_then_settle): same TLV-in-
+# field-1 extension shape, next free tag
+_PB_TAG_CATCHUP_ORD = 17
 
 # A Byzantine frame must not make us allocate from a length varint.
 MAX_PB_FIELD = 64 * 1024 * 1024
@@ -144,6 +149,9 @@ def encode_pb_message(msg: Message) -> bytes:
     elif isinstance(p, CatchupRespPayload):
         _k, tlv = _encode_payload(p)
         one = _len_field(_PB_TAG_CATCHUP_RESP, _len_field(1, tlv))
+    elif isinstance(p, CatchupOrdPayload):
+        _k, tlv = _encode_payload(p)
+        one = _len_field(_PB_TAG_CATCHUP_ORD, _len_field(1, tlv))
     else:
         raise ValueError(
             f"{type(p).__name__} has no slot in the reference's oneof"
@@ -172,7 +180,8 @@ def decode_pb_message(data: bytes, sender_id: str = "") -> Message:
             # unknown scalar fields skip per proto3 semantics (forward
             # compatibility); the KNOWN tags are all length-delimited
             if tag in (
-                1, 2, 3, 4, _PB_TAG_CATCHUP_REQ, _PB_TAG_CATCHUP_RESP
+                1, 2, 3, 4, _PB_TAG_CATCHUP_REQ, _PB_TAG_CATCHUP_RESP,
+                _PB_TAG_CATCHUP_ORD,
             ):
                 raise ValueError(
                     f"wire type {wt} for known tag {tag} (expected LEN)"
@@ -199,7 +208,9 @@ def decode_pb_message(data: bytes, sender_id: str = "") -> Message:
             ts = _parse_timestamp(body)
         elif tag in (3, 4):
             payload = _parse_inner(tag, body)
-        elif tag in (_PB_TAG_CATCHUP_REQ, _PB_TAG_CATCHUP_RESP):
+        elif tag in (
+            _PB_TAG_CATCHUP_REQ, _PB_TAG_CATCHUP_RESP, _PB_TAG_CATCHUP_ORD
+        ):
             payload = _parse_catchup(tag, body)
         # unknown LEN fields are skipped, per proto3 semantics
     if payload is None:
@@ -225,11 +236,12 @@ def _parse_catchup(tag: int, body: bytes) -> Payload:
         if ftag == 1:
             tlv = body[o : o + ln]
         o += ln
-    kind = (
-        _KIND_CATCHUP_REQ
-        if tag == _PB_TAG_CATCHUP_REQ
-        else _KIND_CATCHUP_RESP
-    )
+    if tag == _PB_TAG_CATCHUP_REQ:
+        kind = _KIND_CATCHUP_REQ
+    elif tag == _PB_TAG_CATCHUP_RESP:
+        kind = _KIND_CATCHUP_RESP
+    else:
+        kind = _KIND_CATCHUP_ORD
     return _decode_payload(kind, tlv)
 
 

@@ -145,15 +145,21 @@ class Histogram:
 
 
 class EpochTrace:
-    """Phase timestamps for one epoch: propose -> acs_output -> commit
-    (the per-epoch phase timing of SURVEY.md §5.1)."""
+    """Phase timestamps for one epoch: propose -> acs_output ->
+    [ordered ->] commit (the per-epoch phase timing of SURVEY.md §5.1;
+    ``t_ordered`` is set only on the two-frontier path,
+    Config.order_then_settle, where commit = settle)."""
 
-    __slots__ = ("epoch", "t_propose", "t_acs_output", "t_commit", "n_txs")
+    __slots__ = (
+        "epoch", "t_propose", "t_acs_output", "t_ordered", "t_commit",
+        "n_txs",
+    )
 
     def __init__(self, epoch: int):
         self.epoch = epoch
         self.t_propose: Optional[float] = None
         self.t_acs_output: Optional[float] = None
+        self.t_ordered: Optional[float] = None
         self.t_commit: Optional[float] = None
         self.n_txs: int = 0
 
@@ -175,6 +181,22 @@ class EpochTrace:
             return None
         return self.t_commit - self.t_acs_output
 
+    @property
+    def ordered_s(self) -> Optional[float]:
+        """Propose -> ciphertext-ordered commit: the protocol-plane
+        latency as the APPLICATION'S ordering sees it."""
+        if self.t_propose is None or self.t_ordered is None:
+            return None
+        return self.t_ordered - self.t_propose
+
+    @property
+    def settle_lag_s(self) -> Optional[float]:
+        """Ordered -> settled: how long the epoch's plaintext trailed
+        its ordering (the decrypt-lag wall)."""
+        if self.t_ordered is None or self.t_commit is None:
+            return None
+        return self.t_commit - self.t_ordered
+
 
 @guarded_by("_lock", "_traces", "_last_commit_t")
 class Metrics:
@@ -191,9 +213,17 @@ class Metrics:
         # VISIBLE — before it, absorption happened silently across a
         # dozen private sets
         self.dedup_absorbed = Counter()
+        # two-frontier commit (Config.order_then_settle): epochs whose
+        # ciphertext ordering committed (the ordered frontier's tally;
+        # settlement lands in epochs_committed as before)
+        self.epochs_ordered = Counter()
         self.epoch_latency = Histogram()  # seconds, propose -> commit
         self.acs_latency = Histogram()
         self.decrypt_latency = Histogram()
+        # propose -> ciphertext-ordered commit (the ordered frontier's
+        # epoch latency) and ordered -> settled (the decrypt lag wall)
+        self.ordered_latency = Histogram()
+        self.settle_lag_latency = Histogram()
         self._traces: Dict[int, EpochTrace] = {}
         self._trace_cap = trace_cap
         self._t0 = time.monotonic()
@@ -220,6 +250,12 @@ class Metrics:
         # .alerts_block, set by the host/cluster that owns the
         # watchdog): folds health + per-alert counters into snapshot()
         self._alerts: Optional[Callable[[], Dict]] = None
+        # frontier provider (set by the owning HoneyBadger): () ->
+        # (ordered_frontier, settled_frontier).  decrypt_lag_epochs =
+        # ordered - settled is THE two-frontier health signal — zero on
+        # the coupled path, bounded by Config.decrypt_lag_max on the
+        # order-then-settle path.
+        self._frontiers: Optional[Callable[[], Tuple[int, int]]] = None
 
     def set_transport_health(
         self, provider: Optional[Callable[[], Dict]]
@@ -239,6 +275,19 @@ class Metrics:
     def set_alerts(self, provider: Optional[Callable[[], Dict]]) -> None:
         self._alerts = provider
 
+    def set_frontiers(
+        self, provider: Optional[Callable[[], Tuple[int, int]]]
+    ) -> None:
+        self._frontiers = provider
+
+    def decrypt_lag_epochs(self) -> int:
+        """Ordered frontier - settled frontier (0 when no provider is
+        registered, and 0 by construction on the coupled path)."""
+        if self._frontiers is None:
+            return 0
+        ordered, settled = self._frontiers()
+        return max(0, ordered - settled)
+
     def trace(self, epoch: int) -> EpochTrace:
         with self._lock:
             tr = self._traces.get(epoch)
@@ -255,6 +304,15 @@ class Metrics:
     def epoch_acs_output(self, epoch: int) -> None:
         self.trace(epoch).t_acs_output = time.monotonic()
 
+    def epoch_ordered(self, epoch: int) -> None:
+        """The ciphertext-ordered commit instant (two-frontier path):
+        the ordered frontier advanced past ``epoch``."""
+        tr = self.trace(epoch)
+        tr.t_ordered = time.monotonic()
+        self.epochs_ordered.inc()
+        if tr.ordered_s is not None:
+            self.ordered_latency.observe(tr.ordered_s)
+
     def epoch_committed(self, epoch: int, n_txs: int) -> None:
         tr = self.trace(epoch)
         tr.t_commit = time.monotonic()
@@ -269,6 +327,21 @@ class Metrics:
             self.acs_latency.observe(tr.acs_s)
         if tr.decrypt_s is not None:
             self.decrypt_latency.observe(tr.decrypt_s)
+        if tr.settle_lag_s is not None:
+            self.settle_lag_latency.observe(tr.settle_lag_s)
+
+    def epoch_spans(self) -> List[Tuple[int, float, float]]:
+        """(epoch, t_propose, t_commit) for every retained epoch trace
+        with both endpoints — the per-epoch serial walls an overlap
+        ratio needs (serial sum / elapsed wall > 1 means epochs
+        genuinely overlapped)."""
+        with self._lock:
+            traces = list(self._traces.items())
+        return sorted(
+            (epoch, t.t_propose, t.t_commit)
+            for epoch, t in traces
+            if t.t_propose is not None and t.t_commit is not None
+        )
 
     def tx_per_sec(self) -> float:
         dt = time.monotonic() - self._t0
@@ -303,6 +376,22 @@ class Metrics:
             "acs_p50_s": self.acs_latency.p50,
             "decrypt_p50_s": self.decrypt_latency.p50,
         }
+        # two-frontier block: ALWAYS present (zeroed on the coupled
+        # path) — same appear/disappear contract as "transport" below
+        frontiers: Dict[str, object] = {
+            "epochs_ordered": self.epochs_ordered.value,
+            "ordered_p50_s": self.ordered_latency.p50,
+            "settle_lag_p50_s": self.settle_lag_latency.p50,
+            "decrypt_lag_epochs": 0,
+            "ordered_frontier": 0,
+            "settled_frontier": 0,
+        }
+        if self._frontiers is not None:
+            ordered, settled = self._frontiers()
+            frontiers["ordered_frontier"] = ordered
+            frontiers["settled_frontier"] = settled
+            frontiers["decrypt_lag_epochs"] = max(0, ordered - settled)
+        out["frontiers"] = frontiers
         # every transport key is ALWAYS present (zeroed when no frame
         # counters registered): scrapers and the timeseries sampler
         # must never see a key appear/disappear between snapshots —

@@ -60,6 +60,11 @@ CATEGORIES = frozenset(
         "bba",  # binary agreement rounds and decisions
         "coin",  # threshold-coin share issue + reveal
         "tpke",  # threshold encryption: encrypt/share/combine
+        "settle",  # the trailing decrypt frontier (two-frontier commit
+        # split): dec-share issue/combine run by the settler, plus the
+        # per-epoch ordered->settled decrypt_lag bracket — kept apart
+        # from "tpke" so open->ordered critical paths show exactly the
+        # mass that LEFT them
         "hub",  # CryptoHub batched-dispatch flushes
         "transport",  # envelope coalescing, waves, queue depth
         "ledger",  # WAL appends / checkpoints

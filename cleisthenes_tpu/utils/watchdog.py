@@ -18,6 +18,12 @@ node:
 - **peer_lag**: any peer reported DOWN by the transport health
   tracker, or (in-proc clusters) any peer whose epoch frontier trails
   the roster's by more than a configured gap.
+- **settle_stall**: the two-frontier commit split
+  (Config.order_then_settle) has its ordered frontier sitting at the
+  ``decrypt_lag_max`` backpressure bound — ciphertext ordering is
+  parked because plaintext settlement stopped trailing it (e.g. a
+  share-forging coalition delaying the decryption exchange).  Flips
+  DEGRADED, not DOWN: ordering holds safely at the bound.
 
 Each firing increments a monotonic alert counter, records the reason,
 and emits a trace instant (category ``alert``) so alerts land on the
@@ -50,6 +56,7 @@ DOWN = "down"
 EPOCH_STALL = "epoch_stall"
 QUEUE_BACKPRESSURE = "queue_backpressure"
 PEER_LAG = "peer_lag"
+SETTLE_STALL = "settle_stall"
 
 
 class _Alert:
@@ -80,6 +87,7 @@ class SloWatchdog:
         peer_lag_epochs: int = 8,
         peer_states_fn: Optional[Callable[[], Dict[str, str]]] = None,
         peer_lag_fn: Optional[Callable[[], Dict[str, int]]] = None,
+        decrypt_lag_budget: int = 4,
         trace=None,
     ) -> None:
         if stall_factor <= 0 or stall_grace_s <= 0:
@@ -95,10 +103,22 @@ class SloWatchdog:
         self.peer_lag_epochs = peer_lag_epochs
         self._peer_states = peer_states_fn
         self._peer_lag = peer_lag_fn
+        # the settle-stall SLO budget: ordered - settled at (or past)
+        # this bound means the trailing decrypt frontier is wedged and
+        # ordering is parked on backpressure.  The natural value is
+        # Config.decrypt_lag_max — the same bound the protocol parks
+        # at — read via metrics.decrypt_lag_epochs() (zero on the
+        # coupled path, so the detector is inert there).
+        self.decrypt_lag_budget = decrypt_lag_budget
         self.trace = trace
         self._alerts: Dict[str, _Alert] = {
             name: _Alert(name)
-            for name in (EPOCH_STALL, QUEUE_BACKPRESSURE, PEER_LAG)
+            for name in (
+                EPOCH_STALL,
+                QUEUE_BACKPRESSURE,
+                PEER_LAG,
+                SETTLE_STALL,
+            )
         }
         self._lock = threading.Lock()
 
@@ -143,6 +163,22 @@ class SloWatchdog:
             PEER_LAG,
             bool(lagging),
             lambda: "peers down/lagging: " + ",".join(lagging[:8]),
+        )
+        decrypt_lag = self._metrics.decrypt_lag_epochs()
+        # lag AT the bound alone is the intended steady state of a
+        # decrypt-bound node (ordering oscillates at the backpressure
+        # bound while settlement streams behind); the alert condition
+        # is the bound WITH settlement no longer progressing — same
+        # self-calibrating leash as EPOCH_STALL, since settles are
+        # commits on the two-frontier path
+        self._transition(
+            SETTLE_STALL,
+            decrypt_lag >= self.decrypt_lag_budget
+            and self._metrics.last_commit_age_s(now) > budget,
+            lambda: f"ordered frontier {decrypt_lag} epochs ahead of "
+            f"settlement (budget {self.decrypt_lag_budget}) with no "
+            f"settle for > {round(budget, 3)}s; ordering parked on "
+            "decrypt-lag backpressure",
         )
         return self.health()
 
@@ -229,6 +265,7 @@ __all__ = [
     "EPOCH_STALL",
     "QUEUE_BACKPRESSURE",
     "PEER_LAG",
+    "SETTLE_STALL",
     "SloWatchdog",
     "worst_health",
 ]

@@ -197,6 +197,12 @@ def _golden_target() -> ObsTarget:
         m.epoch_latency.observe(v)
     m.acs_latency.observe(0.04)
     m.decrypt_latency.observe(0.01)
+    # two-frontier commit split (ISSUE 8): ordered-frontier latency,
+    # the trailing settle lag, and a 1-epoch decrypt lag in flight
+    m.ordered_latency.observe(0.03)
+    m.settle_lag_latency.observe(0.02)
+    m.epochs_ordered.inc(3)
+    m.set_frontiers(lambda: (3, 2))
     m.tx_per_sec = lambda: 1.5  # pin the one wall-clock-derived gauge
     m.set_transport_stats(lambda: {"delivered": 7, "rejected": 1})
     m.set_transport_health(
@@ -527,12 +533,111 @@ def test_perfgate_seed_then_pass_then_inflated_fail(tmp_path):
     assert records[0]["hub_dispatches"] == records[1]["hub_dispatches"]
     assert records[0]["stage_shares"], "traced run carries stage shares"
     inflated = dict(records[-1])
-    inflated["epoch_p50_ms"] = records[-1]["epoch_p50_ms"] * 100 + 10_000
+    # the gate keys on the ORDERED-frontier p50 when both sides carry
+    # it (two-frontier commit split) and falls back to epoch_p50_ms
+    # otherwise — inflate both so either key path trips
+    for key in ("epoch_p50_ms", "ordered_epoch_p50_ms"):
+        if isinstance(inflated.get(key), (int, float)):
+            inflated[key] = inflated[key] * 100 + 10_000
     bad = tmp_path / "inflated.json"
     bad.write_text(json.dumps(inflated), encoding="utf-8")
     assert perfgate.main(args + ["--record", str(bad)]) == 1
     # --record never pollutes the trend
     assert len(perfgate.load_trend(trend)) == 2
+
+
+def test_perfgate_share_stall_retries_but_real_leak_fails(
+    tmp_path, monkeypatch
+):
+    """A one-sample scheduler stall (one stage's share inflated on the
+    first measurement, clean on the re-measure) passes; a leak that
+    reproduces on every sample still fails the share gate."""
+    from tools import perfgate
+
+    base = {
+        "kind": "perfgate_mini",
+        "fingerprint": {"kind": "perfgate_mini", "n": 4},
+        "epoch_p50_ms": 50.0,
+        "hub_dispatches": 30,
+        "stage_shares": {"transport": 0.3, "rbc": 0.2},
+    }
+    trend = str(tmp_path / "trend.jsonl")
+    perfgate.append_record(trend, base)
+    stalled = dict(base, stage_shares={"transport": 0.7, "rbc": 0.1})
+    clean = dict(base)
+
+    def make_sampler(samples):
+        it = iter(samples)
+
+        def sample(**kwargs):
+            return dict(next(it))
+
+        return sample
+
+    # stall on sample 1, clean on the retry: the min-share re-measure
+    # absorbs it
+    monkeypatch.setattr(
+        perfgate, "run_sample", make_sampler([stalled, clean, clean])
+    )
+    assert perfgate.main(["--trend", trend, "--no-append"]) == 0
+    # the same inflated share on EVERY sample is a real leak
+    monkeypatch.setattr(
+        perfgate,
+        "run_sample",
+        make_sampler([stalled, stalled, stalled]),
+    )
+    assert perfgate.main(["--trend", trend, "--no-append"]) == 1
+
+
+def test_perfgate_inflated_total_is_not_share_gated():
+    """A fresh run whose own epoch p50 blew past the trend median is
+    host noise: its shares are meaningless and must not trip the
+    share gate (the p50 band still guards real regressions)."""
+    from tools import perfgate
+
+    base = {
+        "fingerprint": {"kind": "t"},
+        "epoch_p50_ms": 50.0,
+        "hub_dispatches": 30,
+        "stage_shares": {"transport": 0.3, "rbc": 0.2},
+    }
+    trend = [dict(base) for _ in range(3)]
+    # total within the p50 noise band but >1.25x the median, shares
+    # skewed by the stall: share gate skipped, run passes
+    noisy = dict(
+        base,
+        epoch_p50_ms=80.0,
+        stage_shares={"transport": 0.7, "rbc": 0.1},
+    )
+    ok, reasons = perfgate.compare(noisy, trend)
+    assert ok, reasons
+    # same skew at an un-inflated total IS a leak hiding inside an
+    # unchanged total — exactly what the share gate is for
+    leak = dict(base, stage_shares={"transport": 0.7, "rbc": 0.1})
+    ok, reasons = perfgate.compare(leak, trend)
+    assert not ok and any("stage-share" in r for r in reasons)
+    # two-frontier records: the gate keys on the ordered p50.  A
+    # settle-track leak keeps the ordered p50 flat while the loop
+    # total grows — the skip must NOT treat that as host noise
+    base2 = dict(base, ordered_epoch_p50_ms=30.0)
+    trend2 = [dict(base2) for _ in range(3)]
+    settle_leak = dict(
+        base2,
+        epoch_p50_ms=80.0,
+        stage_shares={"transport": 0.7, "rbc": 0.1},
+    )
+    ok, reasons = perfgate.compare(settle_leak, trend2)
+    assert not ok and any("stage-share" in r for r in reasons)
+    # whereas a stall that inflates the ordered p50 itself (but stays
+    # inside the 2x band) is host noise: shares skipped
+    stalled = dict(
+        base2,
+        epoch_p50_ms=80.0,
+        ordered_epoch_p50_ms=55.0,
+        stage_shares={"transport": 0.7, "rbc": 0.1},
+    )
+    ok, reasons = perfgate.compare(stalled, trend2)
+    assert ok, reasons
 
 
 def test_perfgate_dispatch_regression_is_noise_free(tmp_path):

@@ -1,0 +1,282 @@
+"""abench: same-box interleaved A/B protocol bench — HEAD vs a git ref.
+
+WAVE_EVIDENCE.md (and the r05 round notes) document the failure mode
+this tool exists for: the recorded 12.2 s protocol_n64 baseline does
+NOT reproduce on another box (HEAD itself measured 18.6-34 s there),
+so comparing a fresh BENCH_*.json against a band recorded elsewhere
+is unusable.  What DOES hold up is a paired comparison: run the two
+code versions alternately on the SAME box inside ONE harness lifetime
+(A B A B ...), so drift, thermal state and background load hit both
+arms symmetrically, and report per-pair deltas instead of absolute
+numbers.
+
+    python -m tools.abench BASE_REF [--n 16] [--batch 256]
+           [--epochs 3] [--pairs 4] [--seed 99]
+    python bench.py --ab BASE_REF        # same thing
+
+Mechanics: ``git worktree add --detach`` materializes BASE_REF under
+``.abench/`` inside the repo, each sample runs in a fresh subprocess
+with its cwd at the matching tree (two code versions cannot share one
+interpreter), and the probe script uses only APIs stable since PR 1
+(Config, SimulatedCluster, the manual propose-and-drain loop) so any
+recent ref can serve as the base arm.  Every subprocess pins
+JAX_PLATFORMS=cpu: A/B runs measure code, not relay weather.
+
+Output: one JSON line — per-arm samples, per-pair head/base ratios,
+and their medians.  ``epoch_p50_ratio_median < 1`` means HEAD is
+faster.  ``ordered_epoch_p50_ms`` rides along when the arm's code
+exposes it (the ISSUE-8 two-frontier split; older refs report null).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import statistics
+import subprocess
+import sys
+from typing import Dict, List, Optional, Sequence
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+WORKTREE_DIR = REPO_ROOT / ".abench"
+
+# The probe every arm runs: manual propose-and-drain epochs over the
+# in-proc cluster, ONE JSON line on stdout.  Only touches APIs that
+# exist on every ref this harness will realistically compare, and
+# degrades gracefully (nulls) where a ref lacks the newer metrics.
+_PROBE = r"""
+import json, statistics, sys, time
+import numpy as np
+from cleisthenes_tpu.config import Config
+from cleisthenes_tpu.protocol.cluster import SimulatedCluster
+
+n, batch, epochs, seed = (
+    int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4])
+)
+# the production shape: work pre-submitted, auto-propose on, ONE
+# net.run chains every epoch back to back — the shape where cross-
+# epoch pipelining (old or two-frontier) is actually reachable.
+cluster = SimulatedCluster(
+    config=Config(n=n, batch_size=batch, crypto_backend="cpu", seed=seed),
+    key_seed=77,
+    auto_propose=True,
+)
+ids = cluster.ids
+rng = np.random.default_rng(13)
+for i in range(batch):  # warm-up epoch (compile, caches), its own txs
+    cluster.nodes[ids[i % n]].add_transaction(
+        rng.integers(0, 256, size=64, dtype=np.uint8).tobytes()
+    )
+for hb in cluster.nodes.values():  # explicit kick: add_transaction
+    hb.start_epoch()               # never opens an epoch by itself
+cluster.net.run()
+assert len(cluster.nodes[ids[0]].committed_batches) >= 1
+for i in range(batch * epochs):
+    cluster.nodes[ids[i % n]].add_transaction(
+        rng.integers(0, 256, size=64, dtype=np.uint8).tobytes()
+    )
+n0 = cluster.nodes[ids[0]]
+before = len(n0.committed_batches)
+t0 = time.perf_counter()
+for hb in cluster.nodes.values():  # kick; auto-propose chains on
+    hb.start_epoch()
+cluster.net.run()
+elapsed = time.perf_counter() - t0
+cluster.assert_agreement()
+done = len(n0.committed_batches) - before
+m = n0.metrics
+epoch_p50 = m.epoch_latency.p50
+ordered = getattr(m, "ordered_latency", None)
+ordered_p50 = ordered.p50 if ordered is not None else None
+lag = getattr(m, "settle_lag_latency", None)
+lag_p95 = lag.p95 if lag is not None else None
+print(json.dumps({
+    # per-epoch cadence over the chained run (wall / epochs): the
+    # throughput number a paired ratio compares
+    "epoch_wall_ms": round(elapsed * 1000.0 / max(1, done), 3),
+    "elapsed_ms": round(elapsed * 1000.0, 3),
+    "epochs": done,
+    # per-epoch propose -> commit p50 from the node metrics (the
+    # latency number; on two-frontier code this is the SETTLED p50)
+    "epoch_p50_ms": (
+        round(epoch_p50 * 1000.0, 3) if epoch_p50 is not None else None
+    ),
+    "ordered_epoch_p50_ms": (
+        round(ordered_p50 * 1000.0, 3) if ordered_p50 is not None else None
+    ),
+    "decrypt_lag_p95_ms": (
+        round(lag_p95 * 1000.0, 3) if lag_p95 is not None else None
+    ),
+}))
+"""
+
+
+def _git(args: Sequence[str], cwd: pathlib.Path = REPO_ROOT) -> str:
+    return subprocess.run(
+        ["git", *args], cwd=str(cwd), check=True,
+        capture_output=True, text=True,
+    ).stdout.strip()
+
+
+def materialize_ref(ref: str) -> pathlib.Path:
+    """A detached worktree of ``ref`` under .abench/ (reused when the
+    resolved commit already sits there)."""
+    sha = _git(["rev-parse", "--verify", f"{ref}^{{commit}}"])
+    tree = WORKTREE_DIR / sha[:12]
+    if tree.exists():
+        return tree
+    WORKTREE_DIR.mkdir(exist_ok=True)
+    _git(["worktree", "add", "--detach", str(tree), sha])
+    return tree
+
+
+def remove_worktree(tree: pathlib.Path) -> None:
+    try:
+        _git(["worktree", "remove", "--force", str(tree)])
+    except subprocess.CalledProcessError:
+        pass  # leave it for `git worktree prune`; never sink a report
+
+
+def run_sample(
+    tree: pathlib.Path, n: int, batch: int, epochs: int, seed: int
+) -> Dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PYTHONPATH", None)  # each arm imports from its own tree
+    proc = subprocess.run(
+        [sys.executable, "-c", _PROBE,
+         str(n), str(batch), str(epochs), str(seed)],
+        cwd=str(tree),
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=1800,
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"sample in {tree} failed (rc {proc.returncode}): "
+            f"{proc.stderr.strip()[-500:]}"
+        )
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def _ratio(a: Optional[float], b: Optional[float]) -> Optional[float]:
+    if (
+        isinstance(a, (int, float))
+        and isinstance(b, (int, float))
+        and b > 0
+    ):
+        return round(a / b, 4)
+    return None
+
+
+def run_ab(
+    base_ref: str,
+    n: int = 16,
+    batch: int = 256,
+    epochs: int = 3,
+    pairs: int = 4,
+    seed: int = 99,
+    keep_worktree: bool = False,
+    progress=print,
+) -> Dict:
+    """The paired A/B: HEAD and BASE_REF sampled alternately, one
+    warm-up pair discarded, ratios computed per pair."""
+    base_tree = materialize_ref(base_ref)
+    head: List[Dict] = []
+    base: List[Dict] = []
+    try:
+        # warm-up pair (imports, JIT, page cache) — never reported
+        progress(f"[abench] warm-up pair (base={base_ref})")
+        run_sample(REPO_ROOT, n, batch, epochs, seed)
+        run_sample(base_tree, n, batch, epochs, seed)
+        for i in range(pairs):
+            progress(f"[abench] pair {i + 1}/{pairs} head")
+            head.append(run_sample(REPO_ROOT, n, batch, epochs, seed))
+            progress(f"[abench] pair {i + 1}/{pairs} base")
+            base.append(run_sample(base_tree, n, batch, epochs, seed))
+    finally:
+        if not keep_worktree:
+            remove_worktree(base_tree)
+    wall_ratios = [
+        _ratio(h.get("epoch_wall_ms"), b.get("epoch_wall_ms"))
+        for h, b in zip(head, base)
+    ]
+    p50_ratios = [
+        _ratio(h.get("epoch_p50_ms"), b.get("epoch_p50_ms"))
+        for h, b in zip(head, base)
+    ]
+    # HEAD's ordered frontier vs the base arm's (settled) epoch p50 —
+    # the protocol-plane latency comparison the two-frontier split is
+    # gated on (null when HEAD ran with the split off)
+    ordered_ratios = [
+        _ratio(h.get("ordered_epoch_p50_ms"), b.get("epoch_p50_ms"))
+        for h, b in zip(head, base)
+    ]
+
+    def med(rs):
+        valid = [r for r in rs if r is not None]
+        return round(statistics.median(valid), 4) if valid else None
+
+    # honesty about what the "head" arm actually ran: it samples the
+    # WORKING TREE in place (uncommitted edits included), while the
+    # base arm runs a clean worktree of base_ref — flag dirtiness so
+    # a ratio from half-finished edits is never mistaken for HEAD's
+    try:
+        head_dirty = bool(_git(["status", "--porcelain"]).strip())
+    except (subprocess.CalledProcessError, OSError):
+        head_dirty = None  # not a git checkout: leave it unknown
+    return {
+        "metric": "abench_paired",
+        "base_ref": base_ref,
+        "head_dirty": head_dirty,
+        "n": n,
+        "batch": batch,
+        "epochs": epochs,
+        "seed": seed,
+        "pairs": pairs,
+        "head_samples": head,
+        "base_samples": base,
+        "pair_epoch_wall_ratios": wall_ratios,
+        "pair_epoch_p50_ratios": p50_ratios,
+        "pair_ordered_p50_ratios": ordered_ratios,
+        # < 1.0 = HEAD faster, same box, same moment
+        "epoch_wall_ratio_median": med(wall_ratios),
+        "epoch_p50_ratio_median": med(p50_ratios),
+        "ordered_p50_ratio_median": med(ordered_ratios),
+    }
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tools.abench", description=__doc__.splitlines()[0]
+    )
+    ap.add_argument("base_ref", help="git ref for the base arm")
+    ap.add_argument("--n", type=int, default=16)
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--pairs", type=int, default=4)
+    ap.add_argument("--seed", type=int, default=99)
+    ap.add_argument(
+        "--keep-worktree", action="store_true",
+        help="leave .abench/<sha> in place for re-runs",
+    )
+    args = ap.parse_args(argv)
+    report = run_ab(
+        args.base_ref,
+        n=args.n,
+        batch=args.batch,
+        epochs=args.epochs,
+        pairs=args.pairs,
+        seed=args.seed,
+        keep_worktree=args.keep_worktree,
+        progress=lambda msg: print(msg, file=sys.stderr, flush=True),
+    )
+    print(json.dumps(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

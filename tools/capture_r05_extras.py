@@ -93,20 +93,18 @@ def _run(wanted) -> int:
 
         stamp("protocol_spmd_n128_g384", g384)
     if "pipelined" in wanted:
+        # the crypto_n512_pipelined software-pipeline section was
+        # retired by the two-frontier split (ISSUE 8); the chip
+        # capture now records the real ordered-vs-settled overlap
         def pipelined():
-            tpu = bench.measure_n512_pipelined("tpu")
-            cpu = bench.measure_n512_pipelined(
-                bench.cpu_reference_backend()
-            )
             return {
-                "tpu": tpu,
-                "cpu": cpu,
-                "vs_cpu": bench._vs(
-                    cpu["epoch_p50_ms"], tpu["epoch_p50_ms"]
+                "tpu": bench.order_overlap_section("tpu"),
+                "cpu": bench.order_overlap_section(
+                    bench.cpu_reference_backend()
                 ),
             }
 
-        stamp("crypto_n512_pipelined_hostoverlap", pipelined)
+        stamp("order_overlap", pipelined)
     if "modexp" in wanted:
         stamp("modexp_wide", bench.measure_modexp_wide)
     out["end_utc"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())

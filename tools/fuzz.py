@@ -223,6 +223,8 @@ def _apply_event(net, ev: dict) -> None:
 
 def _check_safety(cluster, honest: List[str], submitted: set, rnd: int):
     """Raise Violation on any safety breach at this quiescence point."""
+    from cleisthenes_tpu.core.ledger import decode_ordered_body
+
     nodes = cluster.nodes
     depth = min(len(nodes[h].committed_batches) for h in honest)
     for e in range(depth):
@@ -246,6 +248,63 @@ def _check_safety(cluster, honest: List[str], submitted: set, rnd: int):
                         f"in epoch {e}",
                         rnd,
                     )
+    # -- two-frontier invariants (ISSUE 8, Config.order_then_settle) --
+    lag_max = cluster.config.decrypt_lag_max
+    ordered_depth = max(nodes[h].epoch for h in honest)
+    for h in honest:
+        hb = nodes[h]
+        settled = len(hb.committed_batches)
+        # backpressure bound: a coalition delaying settlement (share
+        # forgery) may park ordering AT the bound, never push it past
+        if hb.epoch - settled > lag_max:
+            raise Violation(
+                "decrypt_lag_bound",
+                f"{h} ordered frontier {hb.epoch} ran "
+                f"{hb.epoch - settled} epochs ahead of settlement "
+                f"(bound {lag_max})",
+                rnd,
+            )
+        # the settled prefix is a prefix OF the ordered log: every
+        # settled epoch that was locally ordered commits exactly the
+        # proposals its COrd record agreed on (epochs adopted via
+        # plaintext catch-up alone legitimately carry no COrd)
+        for e in range(settled):
+            body = hb.ordered_record(e)
+            if body is None:
+                continue
+            oepoch, output = decode_ordered_body(body)
+            if oepoch != e:
+                raise Violation(
+                    "ordered_prefix",
+                    f"{h} COrd body for epoch {e} claims epoch "
+                    f"{oepoch}",
+                    rnd,
+                )
+            extra = set(
+                hb.committed_batches[e].contributions
+            ) - set(output)
+            if extra:
+                raise Violation(
+                    "ordered_prefix",
+                    f"{h} settled epoch {e} with proposers "
+                    f"{sorted(extra)} absent from its ordered record",
+                    rnd,
+                )
+    # honest nodes' ordered logs are byte-identical wherever two of
+    # them ordered the same epoch (the ACS output is one agreed value;
+    # COrd bodies are its canonical encoding)
+    for e in range(ordered_depth):
+        bodies = {
+            body
+            for h in honest
+            if (body := nodes[h].ordered_record(e)) is not None
+        }
+        if len(bodies) > 1:
+            raise Violation(
+                "ordered_agreement",
+                f"honest ORDERED logs fork at epoch {e}",
+                rnd,
+            )
 
 
 def run_schedule(

@@ -19,7 +19,13 @@ against the trailing trend with noise bands:
 - **stage shares** (where the epoch's wall time goes, from the PR-3
   critical-path attribution) regress when any stage's share grows by
   more than ``share_tol`` absolute — a latency leak that hides inside
-  an unchanged total still moves its stage's share.
+  an unchanged total still moves its stage's share.  Shares are a
+  wall-clock attribution, so two noise absorbers apply: a fresh run
+  whose own epoch p50 is inflated past the trend is not share-gated
+  at all (its stall is host noise, attributed to whichever stage the
+  scheduler parked on), and a share-only failure is re-measured with
+  each stage's minimum share across samples — a real leak reproduces
+  on every sample, a stall does not.
 
 Workflow (the ci.sh stage):
 
@@ -136,6 +142,16 @@ def append_bench_trend(result: Dict, path: str = str(DEFAULT_TREND)) -> int:
                         "batch": body.get("batch"),
                     },
                     "epoch_p50_ms": p50,
+                    # two-frontier split (ISSUE 8): ordered-frontier
+                    # p50, settled p50 and the trailing-lag p95 ride
+                    # every protocol section that measures them
+                    "ordered_epoch_p50_ms": side.get(
+                        "ordered_epoch_p50_ms"
+                    ),
+                    "settled_epoch_p50_ms": side.get(
+                        "settled_epoch_p50_ms"
+                    ),
+                    "decrypt_lag_p95_ms": side.get("decrypt_lag_p95_ms"),
                     "epoch_times_ms": side.get("epoch_times_ms"),
                     "tx_per_sec": side.get("tx_per_sec"),
                     "stage_shares": side.get("stage_shares"),
@@ -173,11 +189,12 @@ def run_sample(
     from cleisthenes_tpu.utils.trace import to_chrome
     from tools import tracetool
 
+    cfg = Config(
+        n=n, batch_size=batch, seed=seed, trace=True,
+        crypto_backend="cpu",
+    )
     cluster = SimulatedCluster(
-        config=Config(
-            n=n, batch_size=batch, seed=seed, trace=True,
-            crypto_backend="cpu",
-        ),
+        config=cfg,
         seed=seed,
         key_seed=7,
         auto_propose=False,
@@ -201,6 +218,14 @@ def run_sample(
     summary = tracetool.summarize(doc)
     p50 = statistics.median(walls)
     p95 = sorted(walls)[max(0, int(round(0.95 * (len(walls) - 1))))]
+    # two-frontier commit split (ISSUE 8): the per-epoch latencies as
+    # the node metrics saw them — propose -> ciphertext-ordered commit
+    # (the protocol-plane number the gate now keys on), propose ->
+    # settled plaintext, and the trailing decrypt lag's p95
+    m = cluster.nodes[ids[0]].metrics
+    ordered_p50 = m.ordered_latency.p50
+    settled_p50 = m.epoch_latency.p50
+    lag_p95 = m.settle_lag_latency.p95
     return {
         "kind": "perfgate_mini",
         "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
@@ -211,9 +236,26 @@ def run_sample(
             "epochs": epochs,
             "seed": seed,
             "backend": "cpu",
+            # the commit mode changes what the epoch windows (and so
+            # the stage shares) MEAN — runs must never gate against
+            # trend records measured under the other mode
+            "order_then_settle": bool(cfg.order_then_settle),
         },
         "epoch_p50_ms": round(p50 * 1000.0, 3),
         "epoch_p95_ms": round(p95 * 1000.0, 3),
+        "ordered_epoch_p50_ms": (
+            round(ordered_p50 * 1000.0, 3)
+            if ordered_p50 is not None
+            else None
+        ),
+        "settled_epoch_p50_ms": (
+            round(settled_p50 * 1000.0, 3)
+            if settled_p50 is not None
+            else None
+        ),
+        "decrypt_lag_p95_ms": (
+            round(lag_p95 * 1000.0, 3) if lag_p95 is not None else None
+        ),
         "epoch_times_ms": [round(w * 1000.0, 1) for w in walls],
         "stage_shares": tracetool.stage_shares(doc),
         "wave_size_p50": summary["wave_size_p50"],
@@ -240,20 +282,31 @@ def compare(
     """(ok, reasons): gate ``fresh`` against same-fingerprint ``trend``
     records (the caller already windowed and filtered them)."""
     reasons: List[str] = []
-    p50s = [
-        r["epoch_p50_ms"]
+    # the gate keys on the ORDERED-frontier epoch p50 when the fresh
+    # record and the trend both carry it (two-frontier commit split:
+    # the protocol-plane latency an application's ordering sees);
+    # records from before the split — or coupled-arm runs — fall back
+    # to the classic settled/loop epoch p50
+    key = "epoch_p50_ms"
+    if isinstance(
+        fresh.get("ordered_epoch_p50_ms"), (int, float)
+    ) and any(
+        isinstance(r.get("ordered_epoch_p50_ms"), (int, float))
         for r in trend
-        if isinstance(r.get("epoch_p50_ms"), (int, float))
+    ):
+        key = "ordered_epoch_p50_ms"
+    p50s = [
+        r[key] for r in trend if isinstance(r.get(key), (int, float))
     ]
     if p50s:
         med = statistics.median(p50s)
         limit = max(med * (1.0 + rel_tol), med + abs_tol_ms)
-        fresh_p50 = fresh.get("epoch_p50_ms")
+        fresh_p50 = fresh.get(key)
         if not isinstance(fresh_p50, (int, float)):
-            reasons.append("fresh record carries no epoch_p50_ms")
+            reasons.append(f"fresh record carries no {key}")
         elif fresh_p50 > limit:
             reasons.append(
-                f"epoch p50 regression: {fresh_p50:.3f} ms > "
+                f"{key} regression: {fresh_p50:.3f} ms > "
                 f"noise-band limit {limit:.3f} ms "
                 f"(trend median {med:.3f} ms over {len(p50s)} runs)"
             )
@@ -279,6 +332,21 @@ def compare(
         if isinstance(r.get("stage_shares"), dict) and r["stage_shares"]
     ]
     fresh_shares = fresh.get("stage_shares")
+    # stage shares are only comparable between runs of similar wall:
+    # on a loaded host the scheduler's stall lands on whichever stage
+    # it happened to park in, inflating that stage's share while
+    # saying nothing about the code.  Host noise inflates the GATE
+    # KEY's p50 too (the stall sits inside the ordered window), so
+    # skip the share gate only when the same p50 the band above
+    # gated on is itself inflated past the trend — a settle-track
+    # leak that keeps the ordered p50 flat stays share-gated.
+    fresh_key_p50 = fresh.get(key)
+    if (
+        p50s
+        and isinstance(fresh_key_p50, (int, float))
+        and fresh_key_p50 > statistics.median(p50s) * 1.25
+    ):
+        fresh_shares = None
     if trend_shares and isinstance(fresh_shares, dict):
         stages = {s for shares in trend_shares for s in shares}
         for stage in sorted(stages | set(fresh_shares)):
@@ -371,6 +439,40 @@ def main(argv: Optional[List[str]] = None) -> int:
         share_tol=args.share_tol,
         dispatch_tol=args.dispatch_tol,
     )
+    if not ok and not args.record and all(
+        "stage-share" in r for r in reasons
+    ):
+        # a scheduler stall lands on whichever stage the host parked
+        # the process in, inflating that stage's share for ONE sample;
+        # a real latency leak reproduces on every sample.  Re-measure
+        # and keep each stage's minimum share across samples before
+        # declaring a regression.
+        shares_min = {
+            s: float(v)
+            for s, v in (fresh.get("stage_shares") or {}).items()
+        }
+        for _ in range(2):
+            resample = run_sample(
+                n=args.n,
+                batch=args.batch,
+                epochs=args.epochs,
+                seed=args.seed,
+            )
+            re_shares = resample.get("stage_shares") or {}
+            shares_min = {
+                s: min(v, float(re_shares.get(s, 0.0)))
+                for s, v in shares_min.items()
+            }
+            ok, reasons = compare(
+                dict(fresh, stage_shares=shares_min),
+                matching,
+                rel_tol=args.rel_tol,
+                abs_tol_ms=args.abs_tol_ms,
+                share_tol=args.share_tol,
+                dispatch_tol=args.dispatch_tol,
+            )
+            if ok:
+                break
     med = statistics.median(
         [
             r["epoch_p50_ms"]

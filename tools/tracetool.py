@@ -135,10 +135,19 @@ def _point(ev: dict) -> float:
 
 
 def epoch_windows(doc: dict) -> Dict[int, Tuple[float, float]]:
-    """epoch -> (us of earliest open, us of latest commit), for every
-    epoch with both markers."""
+    """epoch -> (us of earliest open, us of latest close), for every
+    epoch with both markers.
+
+    The closing marker is the latest ``epoch/ordered`` instant when
+    the artifact carries one for that epoch (the two-frontier commit
+    split, Config.order_then_settle: the protocol-plane epoch ENDS at
+    the ciphertext-ordered commit; decryption trails on the settle
+    track, visible as the ``settle/decrypt_lag`` spans outside these
+    windows), falling back to the latest ``epoch/commit`` on coupled
+    artifacts."""
     opens: Dict[int, float] = {}
     commits: Dict[int, float] = {}
+    ordereds: Dict[int, float] = {}
     for ev in _analysis_events(doc):
         if ev.get("cat") != "epoch":
             continue
@@ -152,10 +161,14 @@ def epoch_windows(doc: dict) -> Dict[int, Tuple[float, float]]:
         elif ev["name"] == "commit":
             if epoch not in commits or ts > commits[epoch]:
                 commits[epoch] = ts
+        elif ev["name"] == "ordered":
+            if epoch not in ordereds or ts > ordereds[epoch]:
+                ordereds[epoch] = ts
+    closes = {**commits, **ordereds}  # ordered wins where present
     return {
-        e: (opens[e], commits[e])
+        e: (opens[e], closes[e])
         for e in sorted(opens)
-        if e in commits and commits[e] > opens[e]
+        if e in closes and closes[e] > opens[e]
     }
 
 
