@@ -348,6 +348,23 @@ def measure_protocol(
         out["wave_width_p95"] = widths[
             max(0, int(round(0.95 * (len(widths) - 1))))
         ]
+    # delivery-plane columnarization counters (ISSUE 9): payload
+    # decodes and MAC-verify calls the whole run actually executed —
+    # deterministic for the seeded schedule, cluster-wide (the shared
+    # ChannelNetwork serves all n validators), normalized per epoch
+    # (+1: the warm-up epoch's traffic counts too)
+    dstats = net.delivery_stats()
+    run_epochs = epochs + 1
+    out["frames_decoded_per_epoch"] = round(
+        dstats["frames_decoded"] / run_epochs, 1
+    )
+    out["mac_verifies_per_epoch"] = round(
+        dstats["mac_verifies"] / run_epochs, 1
+    )
+    probes = dstats["decode_memo_hits"] + dstats["decode_memo_misses"]
+    out["decode_memo_hit_rate"] = (
+        round(dstats["decode_memo_hits"] / probes, 4) if probes else 0.0
+    )
     out.update(two_frontier_keys(nodes[node_ids[0]].metrics))
     if trace:
         from cleisthenes_tpu.utils.trace import to_chrome

@@ -98,6 +98,10 @@ class Config:
       decrypt_lag_max: backpressure bound on ordered-ahead epochs
         (ordered frontier - settled frontier); also the settle-stall
         SLO watchdog's lag budget.
+      delivery_columnar: columnar inbound delivery plane — wave-batched
+        MAC verification + shared-prefix frame-decode memoization on
+        both transports (see the field comment below).  False is the
+        scalar byte-equivalence arm.
     """
 
     n: int = 4
@@ -148,6 +152,17 @@ class Config:
     # byte-equivalence comparison arm — same seed, same settled
     # plaintext log).
     order_then_settle: bool = True
+    # Delivery-plane columnarization (the inbound twin of
+    # hub_wave_flush): transports buffer inbound frames per message
+    # wave and verify their MACs through ONE
+    # Authenticator.verify_wire_many batch call per wave, and frame
+    # decode memoizes on the signing-prefix digest so a broadcast's N
+    # receiver frames decode once (transport.message.FrameDecodeMemo,
+    # FIFO-evicting).  False reverts to the per-frame scalar receive
+    # path — kept as the live byte-equivalence comparison arm (seeded
+    # runs must commit byte-identical ledgers under either arm;
+    # tests/test_delivery_equivalence.py).
+    delivery_columnar: bool = True
     # Bounded ordered-but-unsettled window: the ordered frontier may
     # run at most this many epochs ahead of the settled frontier
     # before ordering parks (backpressure).  A Byzantine coalition

@@ -239,6 +239,12 @@ class ACS:
         from cleisthenes_tpu.protocol.votebank import VoteBank
 
         self.bank = VoteBank(self.members, config.f, metrics=metrics)
+        # the RBC twin of the vote bank: ECHO/READY receipt state for
+        # every instance as struct-of-arrays (protocol.echobank), so
+        # columnar echo/ready waves update vectorized too
+        from cleisthenes_tpu.protocol.echobank import EchoBank
+
+        self.echo_bank = EchoBank(self.members, config.f, metrics=metrics)
         self.rbcs: Dict[str, RBC] = {}
         self.bbas: Dict[str, BBA] = {}
         for index, proposer in enumerate(self.members):
@@ -251,6 +257,8 @@ class ACS:
                 member_ids=self.members,
                 out=out,
                 hub=hub,
+                bank=self.echo_bank,
+                index=index,
                 trace=trace,
                 metrics=metrics,
             )
@@ -363,28 +371,20 @@ class ACS:
         )
 
     def handle_ready_batch(self, sender: str, p) -> None:
-        rbcs = self.rbcs
-        for i, proposer in enumerate(p.proposers):
-            rbc = rbcs.get(proposer)
-            if rbc is not None:
-                rbc.handle_ready_root(sender, p.roots[i])
+        """One sender's READYs fanned across instances
+        (ReadyBatchPayload): membership, delivered-instance filtering,
+        dedup and per-(root, instance) counting all run vectorized in
+        the EchoBank; only threshold crossings reach RBC logic."""
+        self.echo_bank.batch_ready(sender, p.proposers, p.roots)
 
     def handle_echo_batch(self, sender: str, p) -> None:
         """One sender's ECHOes fanned across instances
-        (EchoBatchPayload): the membership gate hoists out of the
-        loop; the per-instance delivered gate stays inside (RBC
-        instances complete independently)."""
-        rbcs = self.rbcs
-        if sender not in self._member_set:
-            return
-        roots, branches, shards = p.roots, p.branches, p.shards
-        sidx = p.shard_index
-        for i, proposer in enumerate(p.proposers):
-            rbc = rbcs.get(proposer)
-            if rbc is not None and not rbc.delivered:
-                rbc.handle_echo_fast(
-                    sender, roots[i], branches[i], shards[i], sidx
-                )
+        (EchoBatchPayload): the membership + delivered + dedup gates
+        hoist into the EchoBank's vectorized row filters; surviving
+        items park per instance via RBC's claim logic."""
+        self.echo_bank.batch_echo(
+            sender, p.shard_index, p.proposers, p.roots, p.branches, p.shards
+        )
 
     # -- composition rules (img/acs.png) -----------------------------------
 

@@ -113,7 +113,10 @@ class SimulatedCluster:
         self.ids: List[str] = sorted(member_ids)
         self.keys = setup_keys(self.config, self.ids, seed=key_seed,
                                group=group)
-        self.net = ChannelNetwork(seed=seed)
+        self.net = ChannelNetwork(
+            seed=seed,
+            delivery_columnar=self.config.delivery_columnar,
+        )
         # dedup=True: the shared hub verifies each distinct pure crypto
         # check ONCE for the whole roster (see CryptoHub docstring) —
         # the in-proc stand-in for N real hosts verifying in parallel

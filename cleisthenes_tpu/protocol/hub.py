@@ -76,6 +76,7 @@ import numpy as np
 
 from cleisthenes_tpu.ops.backend import BatchCrypto
 from cleisthenes_tpu.ops.tpke import verify_share_groups
+from cleisthenes_tpu.utils.memo import BoundedFifoMemo
 
 # A flush settles in 1-2 wave rounds (branch verdicts unlock decodes
 # WITHIN a round; only share burns and quorum follow-ons need another);
@@ -99,24 +100,12 @@ DECODE_MEMO_CAP = 1 << 10
 WAVE_WIDTH_CAP = 1 << 16
 
 
-class _Memo:
-    """Bounded memo of pure-function results with FIFO eviction: at
-    the cap, the OLDEST insertion is evicted (dict order), never the
-    whole table — a hot epoch sitting near the cap loses one stale
-    entry per fresh one instead of periodically dropping everything
-    and re-verifying the wave's N^2 checks from scratch."""
-
-    __slots__ = ("map", "cap")
-
-    def __init__(self, cap: int):
-        self.map: Dict = {}
-        self.cap = cap
-
-    def put(self, key, val) -> None:
-        m = self.map
-        if len(m) >= self.cap and key not in m:
-            del m[next(iter(m))]  # FIFO: oldest insertion goes first
-        m[key] = val
+# Bounded memo with FIFO eviction (never clear-all): the ONE shared
+# discipline, hoisted to utils.memo so the transport plane's frame-
+# decode memo evicts identically without importing protocol code.
+# The historical name is kept — hub call sites and the tx-parse memo
+# (protocol/honeybadger.py) import it from here.
+_Memo = BoundedFifoMemo
 
 
 class HubWave:

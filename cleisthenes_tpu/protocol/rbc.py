@@ -73,6 +73,8 @@ class RBC:
         member_ids: Sequence[str],
         out,
         hub=None,
+        bank=None,
+        index=None,
         trace=None,
         metrics=None,
     ) -> None:
@@ -94,6 +96,21 @@ class RBC:
 
             hub = CryptoHub(crypto)
         self.hub = hub
+        # ECHO/READY receipt state lives in the roster-wide EchoBank
+        # (protocol.echobank): ACS shares ONE bank across the epoch's
+        # N instances so columnar waves update struct-of-arrays slices;
+        # standalone use (unit tests) gets a private single-instance
+        # bank — the same arrays, width 1.
+        if bank is None:
+            from cleisthenes_tpu.protocol.echobank import EchoBank
+
+            bank = EchoBank(
+                member_ids, config.f, inst_ids=[proposer], metrics=metrics
+            )
+            index = 0
+        self.bank = bank
+        self.index = index
+        bank.attach(index, self)
         # scope is (owner, epoch): a hub may be SHARED by many
         # in-proc validators (cluster-batched dispatches), and one
         # node advancing epochs must only drop ITS clients
@@ -112,12 +129,11 @@ class RBC:
         self._ready_root: Optional[bytes] = None  # root we READY'd
         # One ECHO and one READY per sender per *instance* (a correct
         # node sends exactly one of each; reference rbc/request.go:30-42
-        # repositories are keyed by ConnId).  This also bounds the
-        # number of distinct roots an instance ever tracks to n.  The
+        # repositories are keyed by ConnId) — the claim/dedup state
+        # lives in the EchoBank's [sender, instance] arrays, which also
+        # bound the distinct roots an instance ever counts to n.  The
         # slot is claimed at arrival; a sender whose proof later fails
         # verification has burned its one vote.
-        self._echo_voted: Set[str] = set()
-        self._ready_voted: Set[str] = set()
         # depth of the padded tree the proposer must have built
         # (precomputed: _precheck runs once per delivered ECHO)
         p = 1
@@ -125,16 +141,11 @@ class RBC:
         while p < self.n:
             p <<= 1
             self._depth += 1
-        # root -> sender -> (branch, shard, shard_index) awaiting
-        # batched branch verification
-        self._pending_echo: Dict[bytes, Dict[str, tuple]] = {}
         # root -> set of verified ECHO senders
         self._echo_senders: Dict[bytes, Set[str]] = {}
         # root -> shard_index -> shard bytes (branch-verified)
         self._shards: Dict[bytes, Dict[int, bytes]] = {}
         self._shard_len: Dict[bytes, int] = {}
-        # root -> set of READY senders (rbc/request.go ReadyReqRepository)
-        self._ready_senders: Dict[bytes, Set[str]] = {}
         # roots whose decode+recheck is wanted (ready/echo quorum hit)
         self._decode_req: Set[bytes] = set()
         self._bad_roots: Set[bytes] = set()  # failed interpolation recheck
@@ -291,13 +302,6 @@ class RBC:
             )
         )
 
-    def _echo_potential(self, root: bytes) -> int:
-        """Verified + pending ECHO count for a root — the quorum
-        trigger for a hub flush."""
-        return len(self._echo_senders.get(root, ())) + len(
-            self._pending_echo.get(root, ())
-        )
-
     def _handle_echo(self, sender: str, payload: RbcPayload) -> None:
         self.handle_echo_fast(
             sender,
@@ -316,29 +320,45 @@ class RBC:
         shard_index: int,
     ) -> None:
         """docs/RBC-EN.md:35-39 (reference rbc/rbc.go:60-62) — the
-        field-level entry the columnar EchoBatchPayload path calls
-        once per instance, skipping payload-object dispatch.
-
-        The branch proof is NOT verified here: the echo parks in the
-        pending pool and verifies in the hub's next batched dispatch —
-        triggered below the moment this root could reach its N-f
-        quorum.  Callers on the batch path must have checked
-        delivered/membership (ACS.handle_echo_batch hoists both)."""
-        if sender in self._echo_voted:  # one ECHO per sender
+        field-level scalar entry; the columnar EchoBatchPayload path
+        runs the same claim through EchoBank.batch_echo, which hoists
+        the dedup/delivered/membership filters into vectorized row
+        operations and calls ``_echo_item`` per surviving item."""
+        bank = self.bank
+        si = bank.sidx.get(sender)
+        if si is None:
+            return
+        if bank.echo_seen[si, self.index]:  # one ECHO per sender
             if self.metrics is not None:
                 self.metrics.dedup_absorbed.inc()
             return
+        self._echo_item(si, sender, root, branch, shard, shard_index)
+
+    def _echo_item(
+        self,
+        si: int,
+        sender: str,
+        root: bytes,
+        branch: tuple,
+        shard: bytes,
+        shard_index: int,
+    ) -> None:
+        """Claim + park one deduped ECHO (the per-item protocol logic
+        under both delivery paths).  The branch proof is NOT verified
+        here: the proof parks in the bank's contiguous pending slot
+        and verifies in the hub's next batched dispatch — triggered
+        below the moment this root could reach its N-f quorum."""
         if not self._precheck_fields(root, branch, shard, shard_index):
             return
-        self._echo_voted.add(sender)  # slot claimed; burns if invalid
-        self._pending_echo.setdefault(root, {})[sender] = (
-            branch,
-            shard,
-            shard_index,
+        bank = self.bank
+        # slot claimed; burns if the proof later fails verification
+        pot = bank.echo_claim(self.index, si, root)
+        bank.pending[self.index].append(
+            (root, sender, shard, shard_index, branch)
         )
         self.hub.mark_dirty(self)
         if (
-            self._echo_potential(root) >= self.n - self.f
+            pot >= self.n - self.f
             and self._ready_root is None
             and root not in self._bad_roots
         ):
@@ -359,15 +379,15 @@ class RBC:
     def _handle_ready_root(self, sender: str, root: bytes) -> None:
         if len(root) != 32:
             return
-        if sender in self._ready_voted:  # one READY per sender
-            if self.metrics is not None:
-                self.metrics.dedup_absorbed.inc()
+        bank = self.bank
+        si = bank.sidx.get(sender)
+        if si is None:
             return
-        self._ready_voted.add(sender)
-        senders = self._ready_senders.setdefault(root, set())
-        senders.add(sender)
+        cnt = bank.ready_add(self.index, si, root)
+        if cnt is None:  # one READY per sender (dedup counted in bank)
+            return
         # f+1 READY(h) -> relay READY(h) once (amplification step)
-        if len(senders) >= self.f + 1 and self._ready_root is None:
+        if cnt >= self.f + 1 and self._ready_root is None:
             self._send_ready(root)
         self._maybe_deliver(root)
 
@@ -415,14 +435,14 @@ class RBC:
         (docs/RBC-EN.md:41-42)."""
         if self.delivered:
             return
-        if len(self._ready_senders.get(root, ())) < 2 * self.f + 1:
+        if self.bank.ready_count(self.index, root) < 2 * self.f + 1:
             return
         value = self._decoded.get(root)
         if value is None:
             # decode (or the shard verifications feeding it) is still
             # pending: stage the request and flush if work exists
             self._request_decode(root)
-            if root in self._decode_req or self._pending_echo.get(root):
+            if root in self._decode_req or self.bank.pending[self.index]:
                 self.hub.request_flush()
             if self.delivered:
                 return  # the flush's quorum pass delivered already
@@ -438,11 +458,12 @@ class RBC:
                 proposer=self.proposer,
                 bytes=len(value),
             )
-        # free per-root buffers; the instance is terminal now
+        # free per-root buffers; the instance is terminal now — the
+        # bank's sentinel row drops every later vote vectorized
         self._shards.clear()
         self._echo_senders.clear()
-        self._pending_echo.clear()
         self._decode_req.clear()
+        self.bank.deactivate(self.index)
         if self.on_deliver is not None:
             self.on_deliver(self.proposer, value)
 
@@ -454,24 +475,24 @@ class RBC:
         item, every staged decode whose matrix is complete as a decode
         item (shard BYTES in index order — the hub builds each unique
         matrix once instead of one np.stack per client)."""
-        if self.delivered or not (self._pending_echo or self._decode_req):
+        pend = self.bank.pending[self.index]
+        if self.delivered or not (pend or self._decode_req):
             return  # fast path: the hub may drain a client twice/round
-        # pending ECHO proofs -> batched branch verification (pools
-        # pop wholesale: an emptied root must not linger as an empty
-        # dict and defeat the fast path above)
-        if self._pending_echo:
+        # pending ECHO proofs -> batched branch verification: the
+        # bank's contiguous arrival-order slot pops WHOLESALE into the
+        # wave's branch columns (no per-root dict walk)
+        if pend:
+            self.bank.pending[self.index] = []
             add = wave.add_branch
-            for root in list(self._pending_echo):
-                items = self._pending_echo.pop(root)
-                for sender, (branch, shard, sidx) in items.items():
-                    add(
-                        self,
-                        root,
-                        shard,
-                        branch,
-                        sidx,
-                        (root, sender, shard, sidx),
-                    )
+            for root, sender, shard, sidx, branch in pend:
+                add(
+                    self,
+                    root,
+                    shard,
+                    branch,
+                    sidx,
+                    (root, sender, shard, sidx),
+                )
         # staged decode requests with enough verified shards; sorted:
         # _decode_req is a set of 32-byte roots, and its hash order
         # (PYTHONHASHSEED-dependent) would otherwise decide decode
@@ -510,13 +531,19 @@ class RBC:
         re_mark = False
         for (root, sender, shard, sidx), ok in zip(ctxs, oks):
             if not ok:
-                continue  # invalid: the sender's one slot stays burned
+                # invalid: the sender's one slot stays burned, but the
+                # claim leaves the bank's quorum POTENTIAL — otherwise
+                # f parked forgeries would push pot past n-f forever
+                # and every later honest echo would request a flush
+                self.bank.echo_drop(self.index, root)
+                continue
             # length authority comes only from verified shards; a
             # verified shard conflicting with the established length
             # is a Byzantine proposer mixing lengths under one tree —
             # drop it, RS needs a rectangular matrix
             want = shard_len.setdefault(root, len(shard))
             if len(shard) != want:
+                self.bank.echo_drop(self.index, root)
                 continue
             echo_senders.setdefault(root, set()).add(sender)
             shards.setdefault(root, {})[sidx] = shard
@@ -571,7 +598,7 @@ class RBC:
                 and len(self._echo_senders.get(root, ())) >= self.n - self.f
             ):
                 self._send_ready(root)
-        for root in list(self._ready_senders):
+        for root in self.bank.ready_roots(self.index):
             if self.delivered:
                 break
             self._maybe_deliver(root)

@@ -31,8 +31,10 @@ from cleisthenes_tpu.transport.base import (
     NullAuthenticator,
 )
 from cleisthenes_tpu.transport.message import (
+    FrameDecodeMemo,
     Message,
     decode_frame,
+    decode_frame_shared,
     encode_message,
 )
 
@@ -55,6 +57,15 @@ class ChannelEndpoint:
         self.auth = auth
         self.delivered = 0
         self.rejected = 0  # failed MAC verification
+        # delivery-plane counters (Config.delivery_columnar; zeroed
+        # keys of Metrics.snapshot()["transport"] via endpoint_stats):
+        # payload decodes actually executed / shared-prefix memo
+        # hits+misses / Authenticator verify invocations (one per
+        # frame scalar, one per wave batch columnar)
+        self.frames_decoded = 0
+        self.decode_memo_hits = 0
+        self.decode_memo_misses = 0
+        self.mac_verify_batches = 0
         self.bind(handler)
 
     def bind(self, handler: Handler) -> None:
@@ -111,14 +122,22 @@ class ChannelConnection:
 class ChannelNetwork:
     """N in-proc validators + a deterministic message scheduler."""
 
-    def __init__(self, seed: Optional[int] = None, queue_capacity: int = 1_000_000):
+    def __init__(
+        self,
+        seed: Optional[int] = None,
+        queue_capacity: int = 1_000_000,
+        delivery_columnar: bool = False,
+    ):
         # seed=None -> FIFO delivery; seed=int -> seeded random-order
         # delivery (the adversarial asynchronous scheduler from
         # docs/HONEYBADGER-EN.md:125-140's PBFT comparison).
         self._rng = random.Random(seed) if seed is not None else None
         self._endpoints: Dict[str, ChannelEndpoint] = {}
         # FIFO mode uses a deque (O(1) popleft); seeded mode uses a
-        # list with swap-pop (O(1) uniform removal, order irrelevant)
+        # list with swap-pop (O(1) uniform removal, order irrelevant).
+        # Entries are 5-slot LISTS [sender, receiver, wire, prefiltered,
+        # prepared] — slot 4 holds the columnar arm's pre-wave decode +
+        # MAC verdict (None until a wave pass prepares it).
         self._pending = collections.deque() if seed is None else []
         self._queue_capacity = queue_capacity
         self._crashed: Set[str] = set()
@@ -127,8 +146,20 @@ class ChannelNetwork:
         self.messages_posted = 0
         self.bytes_posted = 0
         # (kind, body) -> payload: one broadcast's body parses once
-        # for all local receivers (see message.decode_frame)
+        # for all local receivers (scalar arm; see message.decode_frame)
         self._payload_memo: dict = {}
+        # Columnar delivery plane (Config.delivery_columnar): frames
+        # decode through the shared-prefix memo and MAC-verify in ONE
+        # Authenticator.verify_wire_many batch per receiver per wave
+        # (_prepare_wave).  The scalar arm above stays byte-equivalent.
+        self._columnar = delivery_columnar
+        self._decode_memo = FrameDecodeMemo() if delivery_columnar else None
+        self._unprepared = 0  # pending entries awaiting a wave pass
+        # network-wide delivery counters (the per-epoch numbers
+        # bench.py sections and perfgate gate on; per-endpoint twins
+        # live on ChannelEndpoint for Metrics.snapshot)
+        self.frames_decoded = 0
+        self.mac_verify_calls = 0
 
     # -- topology ----------------------------------------------------------
 
@@ -157,7 +188,28 @@ class ChannelNetwork:
         ``rejected`` — adversarial tests used to reach through the
         private ``_endpoints`` map for it)."""
         ep = self._endpoints[node_id]
-        return {"delivered": ep.delivered, "rejected": ep.rejected}
+        return {
+            "delivered": ep.delivered,
+            "rejected": ep.rejected,
+            "frames_decoded": ep.frames_decoded,
+            "decode_memo_hits": ep.decode_memo_hits,
+            "decode_memo_misses": ep.decode_memo_misses,
+            "mac_verify_batches": ep.mac_verify_batches,
+        }
+
+    def delivery_stats(self) -> Dict[str, int]:
+        """Network-wide delivery-plane counters (deterministic for a
+        seeded schedule): payload decodes executed, Authenticator
+        verify invocations, and the shared-prefix memo's hit/miss
+        tallies — the numbers bench.py's protocol sections and
+        tools/perfgate.py gate on."""
+        memo = self._decode_memo
+        return {
+            "frames_decoded": self.frames_decoded,
+            "mac_verifies": self.mac_verify_calls,
+            "decode_memo_hits": 0 if memo is None else memo.hits,
+            "decode_memo_misses": 0 if memo is None else memo.misses,
+        }
 
     def link_states(self, node_id: str) -> Dict[str, str]:
         """``node_id``'s view of every peer link: "down" when the peer
@@ -195,6 +247,7 @@ class ChannelNetwork:
             self._pending = collections.deque(kept)
         else:
             self._pending = kept
+        self._unprepared = sum(1 for it in kept if it[4] is None)
 
     def recover(self, node_id: str) -> None:
         """Un-crash, keeping the node's old handler (a blip, not a
@@ -245,7 +298,8 @@ class ChannelNetwork:
             wire = ep.auth.sign_wire_many(msg, [receiver_id])[receiver_id]
         self.messages_posted += 1
         self.bytes_posted += len(wire)
-        self._pending.append((sender_id, receiver_id, wire, False))
+        self._pending.append([sender_id, receiver_id, wire, False, None])
+        self._unprepared += 1
 
     def post_many(
         self, sender_id: str, receiver_ids, msg: Message
@@ -266,10 +320,94 @@ class ChannelNetwork:
                 raise OverflowError("channel network queue full")
             self.messages_posted += 1
             self.bytes_posted += len(wire)
-            self._pending.append((sender_id, rid, wire, False))
+            self._pending.append([sender_id, rid, wire, False, None])
+            self._unprepared += 1
 
     def pending_count(self) -> int:
         return len(self._pending)
+
+    def _prepare_wave(self) -> None:
+        """Columnar arm: decode (shared-prefix memoized) and
+        MAC-verify every not-yet-prepared pending frame — ONE
+        ``verify_wire_many`` batch per receiver per wave.  A wave is
+        whatever the previous handler turns posted since the last
+        pass; the scheduler then delivers prepared frames in its usual
+        (FIFO or seeded) order, so the interleaving semantics are
+        untouched.  Skipped entirely while a fault_filter is mounted:
+        tampering adversaries must see — and re-verify — the exact
+        delivered bytes (the scalar per-frame path below)."""
+        self._unprepared = 0
+        todo: Dict[str, list] = {}
+        crashed, partitions = self._crashed, self._partitions
+        for it in self._pending:
+            # frames the delivery checks would drop anyway (crashed
+            # ends, severed pairs) must not burn digest+decode+MAC
+            # work here or skew the delivery counters — the scalar arm
+            # checks these before ever decoding.  A frame skipped now
+            # that becomes deliverable later (heal/recover) falls to
+            # the scalar per-frame path at pop time.
+            if (
+                it[4] is None
+                and it[1] not in crashed
+                and it[0] not in crashed
+                and (it[0], it[1]) not in partitions
+            ):
+                todo.setdefault(it[1], []).append(it)
+        memo = self._decode_memo
+        for receiver in sorted(todo):  # deterministic endpoint order
+            ep = self._endpoints.get(receiver)
+            if ep is None:
+                continue
+            msgs, prefixes, good = [], [], []
+            tr = getattr(ep.handler, "trace", None)
+            t0 = 0.0 if tr is None else tr.now()
+            wave_hits0 = memo.hits
+            attempts = 0
+            for it in todo[receiver]:
+                attempts += 1
+                h0 = memo.hits
+                try:
+                    msg, prefix = decode_frame_shared(it[2], memo)
+                except ValueError:
+                    it[4] = (None, "undecodable")
+                    continue
+                if memo.hits > h0:
+                    ep.decode_memo_hits += 1
+                else:
+                    ep.decode_memo_misses += 1
+                    ep.frames_decoded += 1
+                    self.frames_decoded += 1
+                msgs.append(msg)
+                prefixes.append(prefix)
+                good.append(it)
+            if tr is not None and attempts:
+                # ONE span per receiver per wave (a per-frame span at
+                # N=64 is ~350k events/run — it would overflow the
+                # trace ring and distort the attribution it feeds):
+                # args carry the wave's decode-attempt and memo-hit
+                # counts, tools/tracetool.py rolls them up
+                tr.complete(
+                    "transport",
+                    "frame_decode",
+                    t0,
+                    frames=attempts,
+                    memo_hits=memo.hits - wave_hits0,
+                )
+            if not msgs:
+                continue
+            self.mac_verify_calls += 1
+            ep.mac_verify_batches += 1
+            t0 = 0.0 if tr is None else tr.now()
+            oks = ep.auth.verify_wire_many(msgs, prefixes)
+            if tr is not None:
+                tr.complete(
+                    "transport",
+                    "mac_verify_batch",
+                    t0,
+                    batch_width=len(msgs),
+                )
+            for it, msg, ok in zip(good, msgs, oks):
+                it[4] = (msg, True) if ok else (None, "bad_mac")
 
     def step(self) -> bool:
         """Deliver one message; returns False if none pending.
@@ -285,19 +423,39 @@ class ChannelNetwork:
         messages appear) — exactly what ``run()`` does — or buffered
         work strands and the protocol stalls without error.
         """
+        columnar = self._columnar and self.fault_filter is None
+        if columnar and self._unprepared:
+            self._prepare_wave()
         while self._pending:
             if self._rng is None:
-                sender, receiver, wire, prefiltered = self._pending.popleft()
+                item = self._pending.popleft()
             else:
                 idx = self._rng.randrange(len(self._pending))
                 item = self._pending[idx]
                 self._pending[idx] = self._pending[-1]
                 self._pending.pop()
-                sender, receiver, wire, prefiltered = item
+            sender, receiver, wire, prefiltered, prepared = item
+            if prepared is None and self._unprepared > 0:
+                # frames skipped by a wave pass (crashed receiver)
+                # deliver through the scalar fallback below
+                self._unprepared -= 1
             if receiver in self._crashed or sender in self._crashed:
                 continue
             if (sender, receiver) in self._partitions:
                 continue
+            ep = self._endpoints.get(receiver)
+            if columnar and prepared is not None:
+                # pre-waved frame: decode + MAC verdict already batched
+                if ep is None:
+                    continue
+                msg, verdict = prepared
+                if verdict is not True:
+                    ep.rejected += 1
+                    self._trace_rejected(ep, sender, verdict)
+                    continue
+                ep.delivered += 1
+                ep.handler.serve_request(msg)
+                return True
             if self.fault_filter is not None and not prefiltered:
                 maybe = self.fault_filter(sender, receiver, wire)
                 if maybe is None:
@@ -312,11 +470,11 @@ class ChannelNetwork:
                     for extra in maybe[1:]:
                         if len(self._pending) < self._queue_capacity:
                             self._pending.append(
-                                (sender, receiver, extra, True)
+                                [sender, receiver, extra, True, None]
                             )
+                            self._unprepared += 1
                 else:
                     wire = maybe
-            ep = self._endpoints.get(receiver)
             if ep is None:
                 continue
             try:
@@ -327,6 +485,10 @@ class ChannelNetwork:
                 ep.rejected += 1
                 self._trace_rejected(ep, sender, "undecodable")
                 continue
+            ep.frames_decoded += 1
+            self.frames_decoded += 1
+            ep.mac_verify_batches += 1
+            self.mac_verify_calls += 1
             if not ep.auth.verify_wire(msg, signing_prefix):
                 # the implemented version of conn.go:134-137's TODO
                 ep.rejected += 1

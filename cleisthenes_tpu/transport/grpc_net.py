@@ -66,6 +66,7 @@ class GrpcConnection:
         capacity: int = DEFAULT_CHANNEL_CAPACITY,
         conn_id: Optional[str] = None,
         on_close: Optional[Callable[["GrpcConnection"], None]] = None,
+        delivery_columnar: bool = False,
     ) -> None:
         self._inbound = inbound
         self._auth = auth
@@ -75,8 +76,16 @@ class GrpcConnection:
         self._closed = threading.Event()
         self._reader: Optional[threading.Thread] = None
         self._on_close = on_close
+        # Config.delivery_columnar: the reader splits into an ingest
+        # thread (stream -> queue) and a verify loop that drains the
+        # queue's backlog per pass — one message wave — and MACs it
+        # through ONE Authenticator.verify_wire_many call.
+        self._columnar = delivery_columnar
         self.delivered = 0
         self.rejected = 0
+        # delivery-plane counters (Metrics.snapshot()["transport"])
+        self.frames_decoded = 0
+        self.mac_verify_batches = 0
 
     # -- Connection interface (conn.go:31-38) ------------------------------
 
@@ -166,6 +175,9 @@ class GrpcConnection:
 
     def _read_loop(self) -> None:
         """readStream + dispatch (conn.go:110-128,164-180)."""
+        if self._columnar:
+            self._read_loop_columnar()
+            return
         try:
             for wire in self._inbound:
                 if self._closed.is_set():
@@ -176,6 +188,8 @@ class GrpcConnection:
                     self.rejected += 1
                     self._trace_rejected("undecodable")
                     continue
+                self.frames_decoded += 1
+                self.mac_verify_batches += 1
                 if not self._auth.verify_wire(  # conn.go:134-137, real
                     msg, signing_prefix
                 ):
@@ -188,6 +202,102 @@ class GrpcConnection:
                     handler.serve_request(msg)
         except Exception:  # staticcheck: allow[ERR001] finally closes the conn
             pass  # stream broken: fall through to close
+        finally:
+            self.close()
+
+    def _ingest_loop(self, q: "queue.Queue") -> None:
+        """Stream -> local queue: the wave buffer's producer side.  The
+        queue is BOUNDED (the scalar path's synchronous consumption
+        exerted backpressure through gRPC flow control; an unbounded
+        buffer here would re-open the flood-to-OOM hole), so a full
+        buffer blocks ingest — and with it the gRPC window — until the
+        verify loop drains.  The sentinel (stream end OR break)
+        releases the verify loop."""
+        try:
+            for wire in self._inbound:
+                if self._closed.is_set():
+                    break
+                while not self._closed.is_set():
+                    try:
+                        q.put(wire, timeout=0.25)
+                        break
+                    except queue.Full:
+                        continue
+        except Exception:  # staticcheck: allow[ERR001] sentinel closes the conn
+            pass  # stream broken: the sentinel ends the verify loop
+        finally:
+            while True:  # the sentinel must land; the verify loop
+                try:  # drains continuously, so this terminates
+                    q.put(_CLOSE, timeout=0.25)
+                    break
+                except queue.Full:
+                    if self._closed.is_set():
+                        break  # verify loop already exiting on the flag
+
+    def _read_loop_columnar(self) -> None:
+        """Wave-batched inbound path (Config.delivery_columnar): drain
+        the ingest queue's current backlog — one message wave, however
+        many frames arrived since the last pass — decode them, and MAC
+        the whole wave through ONE verify_wire_many call before
+        dispatching in arrival order.  Width follows the actual burst
+        shape: a peer's bundle fan-in lands together, so steady-state
+        waves are much wider than 1."""
+        q: "queue.Queue" = queue.Queue(maxsize=self._out.maxsize)
+        threading.Thread(
+            target=self._ingest_loop,
+            args=(q,),
+            name=f"conn-ingest-{self._conn_id[:8]}",
+            daemon=True,
+        ).start()
+        try:
+            ended = False
+            while not ended and not self._closed.is_set():
+                try:
+                    first = q.get(timeout=0.25)
+                except queue.Empty:
+                    continue
+                batch = [first]
+                while True:  # the wave: everything already buffered
+                    try:
+                        batch.append(q.get_nowait())
+                    except queue.Empty:
+                        break
+                msgs, prefixes = [], []
+                for wire in batch:
+                    if wire is _CLOSE:
+                        ended = True
+                        continue
+                    try:
+                        msg, prefix = decode_frame(wire)
+                    except ValueError:
+                        self.rejected += 1
+                        self._trace_rejected("undecodable")
+                        continue
+                    self.frames_decoded += 1
+                    msgs.append(msg)
+                    prefixes.append(prefix)
+                if not msgs:
+                    continue
+                self.mac_verify_batches += 1
+                tr = getattr(self._handler, "trace", None)
+                t0 = 0.0 if tr is None else tr.now()
+                oks = self._auth.verify_wire_many(msgs, prefixes)
+                if tr is not None:
+                    tr.complete(
+                        "transport",
+                        "mac_verify_batch",
+                        t0,
+                        batch_width=len(msgs),
+                    )
+                handler = self._handler
+                for msg, ok in zip(msgs, oks):
+                    if not ok:
+                        self.rejected += 1
+                        self._trace_rejected("bad_mac")
+                        continue
+                    self.delivered += 1
+                    if handler is not None:
+                        handler.serve_request(msg)
         finally:
             self.close()
 
@@ -206,7 +316,14 @@ ConnHandler = Callable[[GrpcConnection], None]  # comm.go:18
 ErrHandler = Callable[[Exception], None]  # comm.go:19
 
 
-@guarded_by("_lock", "_conns", "_delivered_closed", "_rejected_closed")
+@guarded_by(
+    "_lock",
+    "_conns",
+    "_delivered_closed",
+    "_rejected_closed",
+    "_decoded_closed",
+    "_batches_closed",
+)
 class GrpcServer:
     """Reference comm.go:21-99 GrpcServer.
 
@@ -220,10 +337,12 @@ class GrpcServer:
         addr: str,
         auth: Optional[Authenticator] = None,
         capacity: int = DEFAULT_CHANNEL_CAPACITY,
+        delivery_columnar: bool = False,
     ) -> None:
         self.addr = addr
         self._auth = auth or NullAuthenticator()
         self._capacity = capacity
+        self._delivery_columnar = delivery_columnar
         self._on_conn: Optional[ConnHandler] = None
         self._on_err: Optional[ErrHandler] = None
         self._server: Optional[grpc.Server] = None
@@ -234,6 +353,8 @@ class GrpcServer:
         # cumulative across redials
         self._delivered_closed = 0
         self._rejected_closed = 0
+        self._decoded_closed = 0
+        self._batches_closed = 0
 
     def on_conn(self, handler: ConnHandler) -> None:
         """comm.go:65-70."""
@@ -251,6 +372,8 @@ class GrpcServer:
                 return  # already folded into the cumulative counters
             self._delivered_closed += conn.delivered
             self._rejected_closed += conn.rejected
+            self._decoded_closed += conn.frames_decoded
+            self._batches_closed += conn.mac_verify_batches
 
     def stats(self) -> dict:
         """Cumulative inbound frame counters across every stream this
@@ -259,10 +382,19 @@ class GrpcServer:
         with self._lock:
             delivered = self._delivered_closed
             rejected = self._rejected_closed
+            decoded = self._decoded_closed
+            batches = self._batches_closed
             for conn in self._conns:
                 delivered += conn.delivered
                 rejected += conn.rejected
-        return {"delivered": delivered, "rejected": rejected}
+                decoded += conn.frames_decoded
+                batches += conn.mac_verify_batches
+        return {
+            "delivered": delivered,
+            "rejected": rejected,
+            "frames_decoded": decoded,
+            "mac_verify_batches": batches,
+        }
 
     def _stream_behavior(self, request_iterator, context):
         conn = GrpcConnection(
@@ -270,6 +402,7 @@ class GrpcServer:
             self._auth,
             capacity=self._capacity,
             on_close=lambda c: (self._remove_conn(c), context.cancel()),
+            delivery_columnar=self._delivery_columnar,
         )
         with self._lock:
             self._conns.append(conn)
@@ -333,8 +466,13 @@ class DialOpts:
 class GrpcClient:
     """Reference comm.go:119-140 GrpcClient."""
 
-    def __init__(self, auth: Optional[Authenticator] = None):
+    def __init__(
+        self,
+        auth: Optional[Authenticator] = None,
+        delivery_columnar: bool = False,
+    ):
         self._auth = auth or NullAuthenticator()
+        self._delivery_columnar = delivery_columnar
         self._channels: List[grpc.Channel] = []
 
     def dial(self, opts: DialOpts) -> GrpcConnection:
@@ -356,7 +494,11 @@ class GrpcClient:
         # request iterator immediately); the call object then becomes
         # the connection's inbound stream
         conn = GrpcConnection(
-            None, self._auth, capacity=opts.capacity, conn_id=opts.conn_id
+            None,
+            self._auth,
+            capacity=opts.capacity,
+            conn_id=opts.conn_id,
+            delivery_columnar=self._delivery_columnar,
         )
         call = multi(conn.outbound())
         conn._inbound = call

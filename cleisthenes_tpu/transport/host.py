@@ -237,7 +237,11 @@ class GrpcPayloadBroadcaster:
 
 
 @guarded_by(
-    "_closed_stats_lock", "_closed_delivered", "_closed_rejected"
+    "_closed_stats_lock",
+    "_closed_delivered",
+    "_closed_rejected",
+    "_closed_decoded",
+    "_closed_batches",
 )
 class ValidatorHost:
     """One validator process: server + peer dials + HoneyBadger node."""
@@ -266,17 +270,24 @@ class ValidatorHost:
         # (node_id, receiver) pairs
         self.dispatcher = SerialDispatcher(name=f"dispatch-{node_id}")
         self.server = GrpcServer(
-            listen_addr, self._auth, capacity=config.channel_capacity
+            listen_addr,
+            self._auth,
+            capacity=config.channel_capacity,
+            delivery_columnar=config.delivery_columnar,
         )
         self.server.on_conn(self._accept)
         self.pool = ConnectionPool()
-        self._client = GrpcClient(self._auth)
+        self._client = GrpcClient(
+            self._auth, delivery_columnar=config.delivery_columnar
+        )
         # frame counters of dialed streams that have since been lost:
         # folded in at loss time so the transport metric stays
         # cumulative across self-healing redials
         self._closed_stats_lock = threading.Lock()
         self._closed_delivered = 0
         self._closed_rejected = 0
+        self._closed_decoded = 0
+        self._closed_batches = 0
         # per-peer UP/DEGRADED/DOWN + reconnect counters + the recent
         # backoff schedule (proof the dial layer is not spinning)
         self.health = PeerHealthTracker(
@@ -373,14 +384,25 @@ class ValidatorHost:
         stats = self.server.stats()
         delivered = stats["delivered"]
         rejected = stats["rejected"]
+        decoded = stats["frames_decoded"]
+        batches = stats["mac_verify_batches"]
         with self._closed_stats_lock:  # see _on_conn_lost: atomic
             delivered += self._closed_delivered
             rejected += self._closed_rejected
+            decoded += self._closed_decoded
+            batches += self._closed_batches
             conns = self.pool.get_all()
         for conn in conns:
             delivered += getattr(conn, "delivered", 0)
             rejected += getattr(conn, "rejected", 0)
-        return {"delivered": delivered, "rejected": rejected}
+            decoded += getattr(conn, "frames_decoded", 0)
+            batches += getattr(conn, "mac_verify_batches", 0)
+        return {
+            "delivered": delivered,
+            "rejected": rejected,
+            "frames_decoded": decoded,
+            "mac_verify_batches": batches,
+        }
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -489,6 +511,8 @@ class ValidatorHost:
         with self._closed_stats_lock:
             self._closed_delivered += getattr(conn, "delivered", 0)
             self._closed_rejected += getattr(conn, "rejected", 0)
+            self._closed_decoded += getattr(conn, "frames_decoded", 0)
+            self._closed_batches += getattr(conn, "mac_verify_batches", 0)
             self.pool.remove(member)
         self.health.stream_lost(member)
         self.log.warning("peer stream lost", peer=member)

@@ -29,8 +29,11 @@ from __future__ import annotations
 
 import dataclasses
 import enum
+import hashlib
 import struct
 from typing import List, NamedTuple, Optional, Tuple, Union
+
+from cleisthenes_tpu.utils.memo import BoundedFifoMemo
 
 _MAGIC = b"CLTP"  # cleisthenes-tpu wire magic
 _VERSION = 1
@@ -515,6 +518,7 @@ def _check_batch_len(*lens: int) -> None:
 # offset arithmetic rather than _Reader method calls (~2.5x).
 _U32 = struct.Struct(">I")
 _U64 = struct.Struct(">Q")
+_F64 = struct.Struct(">d")
 _QQB = struct.Struct(">QQB")
 _QQI = struct.Struct(">QQI")
 _QI = struct.Struct(">QI")
@@ -805,6 +809,115 @@ def encode_message(msg: Message) -> bytes:
     return attach_signature(signing_bytes(msg), msg.signature)
 
 
+class FrameDecodeMemo(BoundedFifoMemo):
+    """Shared-prefix inbound decode memo (Config.delivery_columnar).
+
+    A broadcast's N receiver frames are ``signing_bytes || len || MAC``
+    (attach_signature) and differ ONLY in the 32-byte MAC — the
+    signing prefix (sender, timestamp, payload body) is byte-identical
+    across all N.  Keying the decoded (sender, ts, kind, payload)
+    tuple on the SHA-256 digest of that prefix collapses N identical
+    decodes to 1 decode + N cheap MAC checks, and shares the envelope
+    fields too (the old (kind, body)-keyed payload memo still decoded
+    sender/timestamp and copied the body bytes per frame).
+
+    Two frames with equal digests but different prefix bytes would be
+    a SHA-256 collision (a second preimage against honest traffic), so
+    aliasing is cryptographically excluded — see docs/ARCHITECTURE.md
+    "Delivery plane".
+
+    Eviction is the shared BoundedFifoMemo discipline (oldest
+    insertion first, utils.memo — the PR-7 hub memo hoisted), NEVER
+    clear-all: a hot wave sitting at the cap loses one stale entry
+    per fresh one instead of periodically re-decoding its whole
+    working set.  ``hits``/``misses`` feed the transport metrics
+    (decode_memo_hit_rate in the bench sections).
+    """
+
+    __slots__ = ("hits", "misses")
+
+    def __init__(self, cap: int = 4096):
+        super().__init__(cap)
+        self.hits = 0
+        self.misses = 0
+
+
+def decode_frame_shared(
+    data: bytes, memo: FrameDecodeMemo
+) -> Tuple[Message, "memoryview"]:
+    """Decode a frame through the shared-prefix memo (the columnar
+    delivery arm of ``decode_frame``).
+
+    The envelope is walked as OFFSETS over ``data`` — no body slice,
+    no signing-prefix copy — and the returned signing prefix is a
+    zero-copy ``memoryview`` (hashlib/hmac consume buffers directly).
+    On a memo hit the entire payload decode is skipped and the shared
+    immutable payload object is reused; per-frame work is then one
+    digest + one dict probe + the Message envelope."""
+    n = len(data)
+    if n < 6 or data[:4] != _MAGIC:
+        raise ValueError("bad magic")
+    version, kind = data[4], data[5]
+    if version != _VERSION:
+        raise ValueError(f"unsupported wire version {version}")
+    o = 6
+    if o + 4 > n:
+        raise ValueError("truncated frame")
+    (sender_len,) = _U32.unpack_from(data, o)
+    if sender_len > MAX_FIELD_BYTES:
+        raise ValueError(f"field length {sender_len} exceeds cap")
+    sender_off = o + 4
+    o = sender_off + sender_len
+    if o + 8 + 4 > n:
+        raise ValueError("truncated frame")
+    ts_off = o
+    (body_len,) = _U32.unpack_from(data, o + 8)
+    if body_len > MAX_FIELD_BYTES:
+        raise ValueError(f"field length {body_len} exceeds cap")
+    body_off = o + 12
+    prefix_end = body_off + body_len
+    if prefix_end + 4 > n:
+        raise ValueError("truncated frame")
+    (sig_len,) = _U32.unpack_from(data, prefix_end)
+    if sig_len > MAX_FIELD_BYTES:
+        raise ValueError(f"field length {sig_len} exceeds cap")
+    sig_off = prefix_end + 4
+    if sig_off + sig_len != n:
+        raise ValueError(
+            "truncated frame" if sig_off + sig_len > n
+            else "trailing bytes in frame"
+        )
+    view = memoryview(data)
+    prefix = view[:prefix_end]
+    digest = hashlib.sha256(prefix).digest()
+    ent = memo.map.get(digest)
+    if ent is None:
+        memo.misses += 1
+        sender = bytes(view[sender_off : sender_off + sender_len]).decode(
+            "utf-8"
+        )
+        (ts,) = _F64.unpack_from(data, ts_off)
+        payload, consumed = _parse_payload(data, body_off, prefix_end, kind)
+        if consumed != prefix_end:
+            # canonical-or-reject, same as _decode_payload: the MAC
+            # covers these bytes and trailing junk is malleability
+            raise ValueError("trailing bytes in payload body")
+        ent = (sender, ts, payload)
+        memo.put(digest, ent)
+    else:
+        memo.hits += 1
+        sender, ts, payload = ent
+    return (
+        Message(
+            sender_id=sender,
+            timestamp=ts,
+            payload=payload,
+            signature=data[sig_off:],
+        ),
+        prefix,
+    )
+
+
 def decode_frame(
     data: bytes, payload_memo: Optional[dict] = None
 ) -> Tuple[Message, bytes]:
@@ -889,6 +1002,8 @@ __all__ = [
     "encode_message",
     "decode_message",
     "decode_frame",
+    "decode_frame_shared",
+    "FrameDecodeMemo",
     "signing_bytes",
     "MAX_FIELD_BYTES",
 ]

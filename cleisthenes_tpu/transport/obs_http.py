@@ -221,6 +221,37 @@ def render_prometheus(targets: Sequence[ObsTarget]) -> str:
             labels,
             int(transport["dedup_absorbed"]),
         )
+        # delivery-plane columnarization counters (always present —
+        # zeroed on the scalar arm per the schema-stability rule)
+        exp.add(
+            exp.family(
+                "transport_frames_decoded_total", "counter",
+                "inbound payload decodes actually executed "
+                "(shared-prefix memo hits skip the decode)",
+            ),
+            labels,
+            int(transport["frames_decoded"]),
+        )
+        memo = exp.family(
+            "transport_decode_memo_total", "counter",
+            "shared-prefix frame-decode memo probes by result",
+        )
+        for result, key in (
+            ("hit", "decode_memo_hits"),
+            ("miss", "decode_memo_misses"),
+        ):
+            exp.add(
+                memo, {**labels, "result": result}, int(transport[key])
+            )
+        exp.add(
+            exp.family(
+                "transport_mac_verify_batches_total", "counter",
+                "authenticator verify invocations (one per wave batch "
+                "columnar; one per frame scalar)",
+            ),
+            labels,
+            int(transport["mac_verify_batches"]),
+        )
         for peer, ph in snap.get("transport_health", {}).items():
             plabels = {**labels, "peer": peer}
             exp.add(

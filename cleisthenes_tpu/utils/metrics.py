@@ -401,6 +401,14 @@ class Metrics:
             "delivered": 0,
             "rejected": 0,
             "dedup_absorbed": self.dedup_absorbed.value,
+            # delivery-plane counters (Config.delivery_columnar): the
+            # PR-5 schema-stability rule — every key present and
+            # zeroed on EVERY path (scalar arm, bare HoneyBadger,
+            # early boot); transports with counters overwrite below
+            "frames_decoded": 0,
+            "decode_memo_hits": 0,
+            "decode_memo_misses": 0,
+            "mac_verify_batches": 0,
         }
         if self._transport_stats is not None:
             transport.update(self._transport_stats())

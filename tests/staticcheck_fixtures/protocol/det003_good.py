@@ -37,3 +37,28 @@ class WaveClient:
         # a local helper that happens to share a hazard name is fine
         # when it is plain data shaping, not a crypto object's method
         return [f"row-{r}" for r in rows]
+
+
+class BankClient:
+    """The EchoBank discipline (ISSUE 9): pending proofs park in a
+    contiguous per-instance bank slot and pop WHOLESALE into the hub
+    wave — no inline verify anywhere on the receive path."""
+
+    def __init__(self, hub, bank, index):
+        self.hub = hub
+        self.bank = bank
+        self.index = index
+
+    def echo_item(self, root, sender, shard, shard_index, branch):
+        self.bank.pending[self.index].append(
+            (root, sender, shard, shard_index, branch)
+        )
+        self.hub.mark_dirty(self)
+
+    def drain_pending(self, wave):
+        pend = self.bank.pending[self.index]
+        self.bank.pending[self.index] = []
+        for root, sender, shard, sidx, branch in pend:
+            wave.add_branch(
+                self, root, shard, branch, sidx, (root, sender)
+            )

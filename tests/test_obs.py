@@ -96,14 +96,19 @@ def test_histogram_cumulative_buckets_and_sum():
 
 
 def test_transport_block_uniform_on_bare_metrics():
-    """Satellite: delivered/rejected/dedup_absorbed are ALWAYS present
-    (zeroed) even before any transport registers its provider — a
-    scraper must never see keys appear mid-run."""
+    """Satellite: every transport key — including the delivery-plane
+    columnarization counters — is ALWAYS present (zeroed) even before
+    any transport registers its provider; a scraper must never see
+    keys appear mid-run."""
     snap = Metrics().snapshot()
     assert snap["transport"] == {
         "delivered": 0,
         "rejected": 0,
         "dedup_absorbed": 0,
+        "frames_decoded": 0,
+        "decode_memo_hits": 0,
+        "decode_memo_misses": 0,
+        "mac_verify_batches": 0,
     }
 
 
@@ -204,7 +209,19 @@ def _golden_target() -> ObsTarget:
     m.epochs_ordered.inc(3)
     m.set_frontiers(lambda: (3, 2))
     m.tx_per_sec = lambda: 1.5  # pin the one wall-clock-derived gauge
-    m.set_transport_stats(lambda: {"delivered": 7, "rejected": 1})
+    m.set_transport_stats(
+        lambda: {
+            "delivered": 7,
+            "rejected": 1,
+            # delivery-plane columnarization counters (zeroed keys on
+            # every path; pinned nonzero here so the golden scrape
+            # covers the new families)
+            "frames_decoded": 6,
+            "decode_memo_hits": 4,
+            "decode_memo_misses": 2,
+            "mac_verify_batches": 3,
+        }
+    )
     m.set_transport_health(
         lambda: {
             'peer"q\\s': {
@@ -422,6 +439,8 @@ def test_cluster_obs_endpoints_scrape():
         assert node0["epochs_committed"] >= 1
         assert set(node0["transport"]) == {
             "delivered", "rejected", "dedup_absorbed",
+            "frames_decoded", "decode_memo_hits",
+            "decode_memo_misses", "mac_verify_batches",
         }
         assert node0["alerts"][EPOCH_STALL]["active"] is False
         status, _ = _get(base + "/nope")

@@ -64,6 +64,10 @@ BAD_FIXTURES = [
     # clock in protocol/ still gates
     "protocol/det001_obs_bad.py",
     "protocol/det002_bad.py",
+    # the EchoBank surface (ISSUE 9): a hand-rolled receipt bank that
+    # iterates sender/root sets in hash order still gates — the bank
+    # exists precisely so no set order reaches the delivery plane
+    "protocol/det002_echobank_bad.py",
     # the columnar seam (ISSUE 7): direct BatchCrypto verify/decode
     # from protocol/ outside hub.py gates, so the wave refactor can't
     # silently erode back to scalar dispatch

@@ -163,6 +163,16 @@ def append_bench_trend(result: Dict, path: str = str(DEFAULT_TREND)) -> int:
                     ),
                     "wave_width_p50": side.get("wave_width_p50"),
                     "wave_width_p95": side.get("wave_width_p95"),
+                    # delivery-plane counters (ISSUE 9)
+                    "frames_decoded_per_epoch": side.get(
+                        "frames_decoded_per_epoch"
+                    ),
+                    "mac_verifies_per_epoch": side.get(
+                        "mac_verifies_per_epoch"
+                    ),
+                    "decode_memo_hit_rate": side.get(
+                        "decode_memo_hit_rate"
+                    ),
                 }
                 append_record(path, record)
                 appended += 1
@@ -226,6 +236,8 @@ def run_sample(
     ordered_p50 = m.ordered_latency.p50
     settled_p50 = m.epoch_latency.p50
     lag_p95 = m.settle_lag_latency.p95
+    dstats = cluster.net.delivery_stats()
+    probes = dstats["decode_memo_hits"] + dstats["decode_memo_misses"]
     return {
         "kind": "perfgate_mini",
         "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
@@ -240,6 +252,10 @@ def run_sample(
             # the stage shares) MEAN — runs must never gate against
             # trend records measured under the other mode
             "order_then_settle": bool(cfg.order_then_settle),
+            # the delivery arm changes what the frame/MAC counters
+            # MEAN (scalar: one decode+verify per frame; columnar:
+            # memoized decode, one verify per wave) — same rule
+            "delivery_columnar": bool(cfg.delivery_columnar),
         },
         "epoch_p50_ms": round(p50 * 1000.0, 3),
         "epoch_p95_ms": round(p95 * 1000.0, 3),
@@ -262,6 +278,17 @@ def run_sample(
         "wave_size_p95": summary["wave_size_p95"],
         "hub_dispatches": int(
             cluster.nodes[ids[0]].hub.stats()["dispatches"]
+        ),
+        # delivery-plane counters (ISSUE 9) — deterministic for the
+        # seeded schedule, gated like hub_dispatches: a delivery-
+        # columnarization regression (memo stops hitting, waves stop
+        # batching) fails here with zero noise
+        "frames_decoded": int(dstats["frames_decoded"]),
+        "mac_verifies": int(dstats["mac_verifies"]),
+        "decode_memo_hit_rate": (
+            round(dstats["decode_memo_hits"] / probes, 4)
+            if probes
+            else 0.0
         ),
     }
 
@@ -310,22 +337,29 @@ def compare(
                 f"noise-band limit {limit:.3f} ms "
                 f"(trend median {med:.3f} ms over {len(p50s)} runs)"
             )
-    dispatches = [
-        r["hub_dispatches"]
-        for r in trend
-        if isinstance(r.get("hub_dispatches"), int)
-    ]
-    fresh_disp = fresh.get("hub_dispatches")
-    if dispatches and isinstance(fresh_disp, int):
-        cap = max(dispatches) * dispatch_tol
-        if fresh_disp > cap:
-            reasons.append(
-                f"hub dispatch regression: {fresh_disp} > "
-                f"{cap:.0f} (trend max {max(dispatches)} * "
-                f"{dispatch_tol}); the seeded run is deterministic — "
-                "this is a wave-batching change, not noise "
-                "(--reset if intentional)"
-            )
+    # deterministic-counter gates: hub dispatches (PR 7) and the
+    # delivery-plane frame/MAC counters (ISSUE 9) share one rule —
+    # the seeded schedule makes them exact, so exceeding the trend
+    # maximum by more than dispatch_tol is a structural regression
+    for counter, what in (
+        ("hub_dispatches", "hub dispatch"),
+        ("frames_decoded", "frame-decode"),
+        ("mac_verifies", "MAC-verify"),
+    ):
+        history = [
+            r[counter] for r in trend if isinstance(r.get(counter), int)
+        ]
+        fresh_v = fresh.get(counter)
+        if history and isinstance(fresh_v, int):
+            cap = max(history) * dispatch_tol
+            if fresh_v > cap:
+                reasons.append(
+                    f"{what} regression: {fresh_v} > "
+                    f"{cap:.0f} (trend max {max(history)} * "
+                    f"{dispatch_tol}); the seeded run is deterministic "
+                    "— this is a batching change, not noise "
+                    "(--reset if intentional)"
+                )
     trend_shares = [
         r["stage_shares"]
         for r in trend

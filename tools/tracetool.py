@@ -266,6 +266,16 @@ def summarize(doc: dict) -> dict:
     wave_sizes: List[float] = []
     hub = {"flushes": 0, "dispatches": 0, "branches": 0, "decodes": 0,
            "shares": 0}
+    # delivery-plane columnarization (ISSUE 9): frame_decode spans
+    # carry memo_hit, mac_verify_batch spans carry batch_width — the
+    # counters a critical-path capture needs to attribute the
+    # delivery-plane delta
+    delivery = {
+        "frame_decodes": 0,
+        "decode_memo_hits": 0,
+        "mac_verify_batches": 0,
+    }
+    batch_widths: List[float] = []
     for ev in _analysis_events(doc):
         cat = ev["cat"]
         by_cat[cat] = by_cat.get(cat, 0) + 1
@@ -282,6 +292,16 @@ def summarize(doc: dict) -> dict:
             msgs = args.get("msgs")
             if isinstance(msgs, (int, float)):
                 wave_sizes.append(float(msgs))
+        elif cat == "transport" and ev["name"] == "frame_decode":
+            # one span covers one prepare-wave's decode attempts for
+            # one receiver; args carry the counts
+            delivery["frame_decodes"] += int(args.get("frames", 1))
+            delivery["decode_memo_hits"] += int(args.get("memo_hits", 0))
+        elif cat == "transport" and ev["name"] == "mac_verify_batch":
+            delivery["mac_verify_batches"] += 1
+            width = args.get("batch_width")
+            if isinstance(width, (int, float)):
+                batch_widths.append(float(width))
     spans = {
         f"{cat}/{name}": {
             "n": len(durs),
@@ -290,9 +310,12 @@ def summarize(doc: dict) -> dict:
         }
         for (cat, name), durs in sorted(span_durs.items())
     }
+    delivery["mac_batch_width_p50"] = _percentile(batch_widths, 50)
+    delivery["mac_batch_width_p95"] = _percentile(batch_widths, 95)
     return {
         "events_by_category": dict(sorted(by_cat.items())),
         "hub": hub,
+        "delivery": delivery,
         "wave_size_p50": _percentile(wave_sizes, 50),
         "wave_size_p95": _percentile(wave_sizes, 95),
         "spans": spans,
@@ -334,6 +357,7 @@ def report(doc: dict, top: int = 5) -> str:
     lines.append("summary:")
     lines.append(f"  events by category: {s['events_by_category']}")
     lines.append(f"  hub: {s['hub']}")
+    lines.append(f"  delivery: {s['delivery']}")
     lines.append(
         f"  wave size p50/p95: {s['wave_size_p50']}/{s['wave_size_p95']}"
     )
