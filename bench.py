@@ -365,6 +365,18 @@ def measure_protocol(
     out["decode_memo_hit_rate"] = (
         round(dstats["decode_memo_hits"] / probes, 4) if probes else 0.0
     )
+    # wave-routed ingest (ISSUE 10): batch handler invocations
+    # crossing the router seam, cluster-wide (all n nodes), per epoch
+    # — deterministic for the seeded schedule, the counter the router
+    # exists to collapse (one per payload scalar; one per kind per
+    # wave routed)
+    out["handler_dispatches_per_epoch"] = round(
+        sum(
+            hb.metrics.handler_dispatches.value for hb in nodes.values()
+        )
+        / run_epochs,
+        1,
+    )
     out.update(two_frontier_keys(nodes[node_ids[0]].metrics))
     if trace:
         from cleisthenes_tpu.utils.trace import to_chrome

@@ -102,6 +102,12 @@ class Config:
         MAC verification + shared-prefix frame-decode memoization on
         both transports (see the field comment below).  False is the
         scalar byte-equivalence arm.
+      wave_routing: wave-routed protocol ingest — the routing-layer
+        twin of delivery_columnar: one batch handler dispatch per
+        (message kind, delivery wave) through protocol.router's
+        WaveRouter instead of one Python call chain per payload (see
+        the field comment below).  False is the scalar per-payload
+        routing comparison arm.
     """
 
     n: int = 4
@@ -163,6 +169,21 @@ class Config:
     # runs must commit byte-identical ledgers under either arm;
     # tests/test_delivery_equivalence.py).
     delivery_columnar: bool = True
+    # Wave-routed protocol ingest (the routing-layer twin of
+    # delivery_columnar): transports hand a delivery wave's verified,
+    # decoded frames to the handler in ONE serve_wave call; the
+    # WaveRouter (protocol.router) demuxes them in a single pass into
+    # typed ingest columns keyed by (epoch, message kind) and invokes
+    # ONE batch handler entry point per (kind, wave) on ACS/RBC/BBA —
+    # replacing the per-payload HoneyBadger.handle_message -> ACS ->
+    # RBC/BBA Python call chain.  Effective only together with
+    # delivery_columnar on the channel wave path; the gRPC transport
+    # additionally folds a wave into one SerialDispatcher mailbox
+    # entry.  False reverts to the per-payload scalar routing chain —
+    # kept as the live byte-equivalence comparison arm (seeded runs
+    # must commit byte-identical ledgers under either arm;
+    # tests/test_delivery_equivalence.py).
+    wave_routing: bool = True
     # Bounded ordered-but-unsettled window: the ordered frontier may
     # run at most this many epochs ahead of the settled frontier
     # before ordering parks (backpressure).  A Byzantine coalition

@@ -386,6 +386,67 @@ class ACS:
             sender, p.shard_index, p.proposers, p.roots, p.branches, p.shards
         )
 
+    # -- wave-routed ingest columns (protocol.router.WaveRouter) -----------
+
+    def handle_vote_wave(self, items) -> None:
+        """One delivery wave's BVAL/AUX/TERM votes across ALL senders
+        and instances (wave routing: one handler dispatch for the
+        whole column).  Non-TERM votes group by (type, round, value)
+        — one sender's columnar batch and a width-1 scalar vote are
+        the same row shape — and each group updates the VoteBank
+        wholesale in a single vectorized pass (VoteBank.wave_vote).
+        TERM stays scalar (a handful per instance, ever)."""
+        bank = self.bank
+        sidx = bank.sidx
+        bbas = self.bbas
+        groups: Dict[tuple, list] = {}
+        for sender, t, rnd, value, proposers in items:
+            if t == BbaType.TERM:
+                for proposer in proposers:
+                    bba = bbas.get(proposer)
+                    if bba is not None:
+                        bba.handle_vote(sender, t, rnd, value)
+                continue
+            si = sidx.get(sender)
+            if si is None:
+                continue
+            key = (t, rnd, value)
+            rows = groups.get(key)
+            if rows is None:
+                groups[key] = [(si, sender, proposers)]
+            else:
+                rows.append((si, sender, proposers))
+        for (t, rnd, value), rows in groups.items():
+            bank.wave_vote(t == BbaType.BVAL, rnd, value, rows)
+
+    def handle_echo_wave(self, items) -> None:
+        """One delivery wave's ECHOes across ALL senders: each row is
+        one sender's fan-out (columnar batch, or a width-1 scalar
+        ECHO) and runs the EchoBank's vectorized membership/delivered/
+        dedup filters — one handler dispatch instead of one per
+        payload."""
+        batch_echo = self.echo_bank.batch_echo
+        for sender, shard_index, proposers, roots, branches, shards in items:
+            batch_echo(
+                sender, shard_index, proposers, roots, branches, shards
+            )
+
+    def handle_ready_wave(self, items) -> None:
+        """One delivery wave's READYs across ALL senders (row shape as
+        in handle_echo_wave)."""
+        batch_ready = self.echo_bank.batch_ready
+        for sender, proposers, roots in items:
+            batch_ready(sender, proposers, roots)
+
+    def handle_coin_wave(self, items) -> None:
+        """One delivery wave's coin shares across ALL senders: each
+        row is one (sender, round) share fan-out and lands as ONE
+        CoinRowStore append (per-instance pools pull lazily)."""
+        sidx = self.bank.sidx
+        for sender, rnd, index, proposers, d, e, z in items:
+            if sender in sidx:
+                self._coin_row(sender, rnd, index, proposers, d, e, z)
+
     # -- composition rules (img/acs.png) -----------------------------------
 
     def _on_rbc_deliver(self, proposer: str, value: bytes) -> None:

@@ -116,6 +116,7 @@ class SimulatedCluster:
         self.net = ChannelNetwork(
             seed=seed,
             delivery_columnar=self.config.delivery_columnar,
+            wave_routing=self.config.wave_routing,
         )
         # dedup=True: the shared hub verifies each distinct pure crypto
         # check ONCE for the whole roster (see CryptoHub docstring) —

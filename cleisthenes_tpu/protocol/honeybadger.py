@@ -383,7 +383,13 @@ class _CountingBroadcaster:
 
 class HoneyBadger:
     """One validator node (reference honeybadger.go:18-34 + the absent
-    epoch driver).  Implements transport.base.Handler."""
+    epoch driver).  Implements transport.base.Handler, plus the
+    wave-ingest extension ``serve_wave`` (Config.wave_routing)."""
+
+    # the demux window's forward horizon, re-exported as a class
+    # attribute so the WaveRouter reads it off its owner without a
+    # circular module import
+    EPOCH_HORIZON = EPOCH_HORIZON
 
     def __init__(
         self,
@@ -488,6 +494,14 @@ class HoneyBadger:
         # entries one serving window behind the settled frontier are
         # pruned (_advance_epoch), bounding the store.
         self._ordered_bodies: Dict[int, bytes] = {}
+        # wave-routed ingest (Config.wave_routing): transports in wave
+        # mode hand whole delivery waves to serve_wave; the router
+        # demuxes them into typed columns and makes one batch handler
+        # dispatch per (kind, wave).  Constructed unconditionally
+        # (cheap); only transports that saw wave_routing on call it.
+        from cleisthenes_tpu.protocol.router import WaveRouter
+
+        self._router = WaveRouter(self)
         # settler reentrancy guard (settling starts the next epoch,
         # whose turn exit would recurse into the settler) and the
         # one-instant-per-parked-epoch trace dedup
@@ -783,6 +797,19 @@ class HoneyBadger:
 
     # -- message demux (transport Handler) ---------------------------------
 
+    def serve_wave(self, msgs) -> None:
+        """Wave-ingest entry (Config.wave_routing): one call carries a
+        whole delivery wave of verified, decoded frames; the router
+        demuxes them into typed columns and invokes one batch handler
+        per (message kind, wave) — the per-payload scalar chain below
+        stays live as the byte-equivalence comparison arm."""
+        try:
+            if self.trace is not None:
+                self._trace_wave_msgs += len(msgs)
+            self._router.route(msgs)
+        finally:
+            self._exit_turn()
+
     def serve_request(self, msg: Message) -> None:
         try:
             if self.trace is not None:
@@ -825,26 +852,19 @@ class HoneyBadger:
         es = self._epochs.get(epoch) or self._epoch_state(epoch)
         if es is None:  # outside the sliding window
             if epoch > self.epoch + EPOCH_HORIZON:
-                # peers are far ahead: we missed epochs, catch up.
-                # The first sighting requests immediately (dedup'd per
-                # frontier); if the frontier then fails to move (our
-                # request or its responses were lost), every further
-                # CATCHUP_RENUDGE_EVERY sightings force a re-broadcast
-                # — a retry clocked by traffic, not wall time
-                self._farahead_sightings += 1
-                self._request_catchup(
-                    force=self._farahead_sightings % CATCHUP_RENUDGE_EVERY
-                    == 0
-                )
+                # peers are far ahead: we missed epochs, catch up
+                self._note_farahead()
             return
         cls = pcls
         if cls is DecSharePayload:
+            self.metrics.handler_dispatches.inc()
             self._handle_dec_share(
                 epoch, es, sender_id, payload.proposer, payload.index,
                 payload.d, payload.e, payload.z,
             )
             return
         if cls is DecShareBatchPayload:
+            self.metrics.handler_dispatches.inc()
             self._handle_dec_share_batch(epoch, es, sender_id, payload)
             return
         if cls in _ACS_PAYLOADS:
@@ -862,6 +882,7 @@ class HoneyBadger:
                 and not es.proposed
             ):
                 self.start_epoch()
+            self.metrics.handler_dispatches.inc()
             if cls is BbaBatchPayload:
                 es.acs.handle_bba_batch(sender_id, payload)
             elif cls is CoinBatchPayload:
@@ -872,6 +893,20 @@ class HoneyBadger:
                 es.acs.handle_ready_batch(sender_id, payload)
             else:
                 es.acs.handle_message(sender_id, payload)
+
+    def _note_farahead(self) -> None:
+        """One sighting of traffic beyond the forward demux horizon
+        (shared by the scalar chain and the wave router, per payload
+        so the renudge cadence matches across arms).  The first
+        sighting requests catch-up immediately (dedup'd per
+        frontier); if the frontier then fails to move (our request or
+        its responses were lost), every further CATCHUP_RENUDGE_EVERY
+        sightings force a re-broadcast — a retry clocked by traffic,
+        not wall time."""
+        self._farahead_sightings += 1
+        self._request_catchup(
+            force=self._farahead_sightings % CATCHUP_RENUDGE_EVERY == 0
+        )
 
     def _epoch_state(self, epoch: int) -> Optional[_EpochState]:
         if not (
@@ -1140,50 +1175,74 @@ class HoneyBadger:
         self, epoch: int, es: _EpochState, sender: str, payload
     ) -> None:
         """One sender's decryption shares across many proposers
-        (DecShareBatchPayload): sender/index validation hoists out of
-        the loop, and the threshold probes (_try_decrypt) plus the
-        commit check run once per TOUCHED proposer / once per frame
-        instead of once per share — identical outcomes, since neither
-        has observable effects below its threshold."""
-        index = payload.index
-        if sender not in self._member_set or not (
-            1 <= index <= self.config.n
-        ):
-            return
+        (DecShareBatchPayload): a width-1 wave — probes once per
+        touched proposer, commit check once per frame (the shared
+        pooling loop lives in _handle_dec_share_wave, so the scalar
+        and wave arms cannot drift apart on the crossing rule)."""
+        self._handle_dec_share_wave(epoch, es, ((sender, payload),))
+
+    def _handle_dec_share_wave(
+        self, epoch: int, es: _EpochState, items
+    ) -> None:
+        """One delivery wave's decryption shares for one epoch across
+        ALL senders (the WaveRouter's dec column; DecShareBatchPayload
+        delegates here as a width-1 wave): every share pools under the
+        same per-(sender, proposer) dedup as the scalar handler; the
+        threshold probes run once per TOUCHED proposer and the commit
+        check once per WAVE — identical outcomes, since neither has
+        observable effects below its threshold.  Probes fire only on
+        the threshold CROSSING (below it nothing can combine; above it
+        the only consumers of fresh shares are a flagged pool needing
+        CP-path replacements and an index-short pool awaiting a
+        distinct Shamir index); missed-window cases re-probe via
+        _on_acs_output (output arrives after crossing) and
+        _on_dec_verdicts (burn with replacements parked)."""
         member = self._member_set
         pools = es.dec_shares
         threshold = self.keys.tpke_pub.threshold
-        dcol, ecol, zcol = payload.d, payload.e, payload.z
+        n = self.config.n
         opt_failed = es.opt_failed
+        opt_short = es.opt_short
         probe = not self._two_frontier  # two-frontier: settler probes
-        touched = []
-        for i, proposer in enumerate(payload.proposers):
-            if proposer not in member:
+        touched: List[str] = []
+        touched_set: Set[str] = set()
+        for sender, p in items:
+            if sender not in member:
                 continue
-            pool = pools.get(proposer)
-            if pool is None:
-                pool = pools.setdefault(proposer, SharePool(threshold))
-            if pool.add_lazy(sender, index, dcol[i], ecol[i], zcol[i]):
-                if not probe:
-                    continue
-                # decrypt probes only on the threshold CROSSING (below
-                # it nothing can combine; above it the only consumers
-                # of fresh shares are a flagged pool needing CP-path
-                # replacements and an index-short pool awaiting a
-                # distinct Shamir index).  Missed-window cases re-probe
-                # via _on_acs_output (output arrives after crossing)
-                # and _on_dec_verdicts (burn with replacements parked).
-                n_pool = len(pool)
-                if n_pool == threshold or (
-                    n_pool > threshold
-                    and (
-                        proposer in opt_failed
-                        or proposer in es.opt_short
-                    )
-                ):
-                    touched.append(proposer)
+            index = p.index
+            if not (1 <= index <= n):
+                continue
+            if p.__class__ is DecSharePayload:
+                proposers = (p.proposer,)
+                dcol, ecol, zcol = (p.d,), (p.e,), (p.z,)
             else:
-                self.metrics.dedup_absorbed.inc()
+                proposers = p.proposers
+                dcol, ecol, zcol = p.d, p.e, p.z
+            for i, proposer in enumerate(proposers):
+                if proposer not in member:
+                    continue
+                pool = pools.get(proposer)
+                if pool is None:
+                    pool = pools.setdefault(
+                        proposer, SharePool(threshold)
+                    )
+                if pool.add_lazy(
+                    sender, index, dcol[i], ecol[i], zcol[i]
+                ):
+                    if not probe or proposer in touched_set:
+                        continue
+                    n_pool = len(pool)
+                    if n_pool == threshold or (
+                        n_pool > threshold
+                        and (
+                            proposer in opt_failed
+                            or proposer in opt_short
+                        )
+                    ):
+                        touched_set.add(proposer)
+                        touched.append(proposer)
+                else:
+                    self.metrics.dedup_absorbed.inc()
         if not touched:
             return
         for proposer in touched:

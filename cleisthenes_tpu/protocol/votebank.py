@@ -184,6 +184,116 @@ class VoteBank:
             self._prop_cache[proposers] = ent
         return ent
 
+    def wave_vote(
+        self,
+        is_bval: bool,
+        rnd: int,
+        value: bool,
+        rows,
+    ) -> None:
+        """One delivery wave's same-(type, round, value) votes across
+        MANY senders (wave routing, protocol.router): dedup + counting
+        run as ONE concatenated fancy-index pass over the
+        [sender, instance] arrays, and threshold crossings are
+        detected by before/after comparison — counts may advance by
+        more than +1 within a wave, so the exact-equality crossing of
+        the per-payload paths generalizes to interval containment
+        (before < thr <= after), which fires exactly once per
+        (instance, threshold) under the same one-vote dedup.
+
+        ``rows`` is a list of (sender_index, sender, proposers).  Rows
+        with duplicate-instance proposers (only Byzantine batches) or
+        any off-round instance fall back to the per-row batch_vote
+        path AFTER the vectorized pass, which re-reads the round state
+        and preserves the exact parking/stale semantics."""
+        rs = self.round_state
+        si_parts: list = []
+        pi_parts: list = []
+        fallback: list = []
+        for si, sender, proposers in rows:
+            pi, dups = self._indices(proposers)
+            if pi.size == 0:
+                continue
+            if dups or (rs[pi] != rnd).any():
+                fallback.append((sender, proposers))
+                continue
+            si_parts.append(np.full(pi.size, si, dtype=np.int64))
+            pi_parts.append(pi)
+        if si_parts:
+            self._wave_apply(
+                is_bval,
+                value,
+                np.concatenate(si_parts),
+                np.concatenate(pi_parts),
+            )
+        for sender, proposers in fallback:
+            self.batch_vote(sender, is_bval, rnd, value, proposers)
+
+    def _wave_apply(
+        self, is_bval: bool, value: bool, si_all, pi_all
+    ) -> None:
+        """The vectorized heart of wave_vote: every (sender, instance)
+        pair is in-round and instance-unique per row; intra-wave
+        duplicate pairs (replayed frames) dedup here, exactly like the
+        seen-bit dedup absorbs them on the per-payload paths."""
+        metrics = self.metrics
+        n_inst = self.round_state.size
+        key = si_all * n_inst + pi_all
+        uniq_k, first_idx = np.unique(key, return_index=True)
+        if uniq_k.size != key.size:
+            if metrics is not None:
+                metrics.dedup_absorbed.inc(int(key.size - uniq_k.size))
+            first_idx.sort()
+            si_all, pi_all = si_all[first_idx], pi_all[first_idx]
+        vi = 1 if value else 0
+        f = self.f
+        bbas = self.bbas
+        if is_bval:
+            seen_plane = self.bval_seen[:, vi]
+        else:
+            seen_plane = self.aux_seen
+        seen = seen_plane[si_all, pi_all]
+        if seen.any():
+            if metrics is not None:
+                metrics.dedup_absorbed.inc(int(seen.sum()))
+            fresh = ~seen
+            si_all, pi_all = si_all[fresh], pi_all[fresh]
+            if pi_all.size == 0:
+                return
+        seen_plane[si_all, pi_all] = True
+        uniq, adds = np.unique(pi_all, return_counts=True)
+        if is_bval:
+            cnt = self.bval_cnt[vi]
+            before = cnt[uniq]
+            cnt[uniq] = after = before + adds.astype(np.int32)
+            # f+1 same bval -> relay once; 2f+1 -> bin_values union
+            # (docs/BBA-EN.md:47-58) — interval crossings, fired after
+            # ALL of the wave's adds landed
+            for i in uniq[(before < f + 1) & (after >= f + 1)]:
+                bba = bbas[i]
+                if bba is not None and not bba.halted:
+                    bba.on_bval_relay(value)
+            for i in uniq[(before < 2 * f + 1) & (after >= 2 * f + 1)]:
+                bba = bbas[i]
+                if bba is not None and not bba.halted:
+                    bba.on_bval_bin(value)
+        else:
+            cnt = self.aux_cnt[vi]
+            cnt[uniq] += adds.astype(np.int32)
+            binf = self.bin_flags[uniq]
+            good = self.aux_cnt[1][uniq] * binf[:, 1] + (
+                self.aux_cnt[0][uniq] * binf[:, 0]
+            )
+            n = len(self.members)
+            trig = uniq[(good >= n - f) & ~self.aux_fired[uniq]]
+            if trig.size == 0:
+                return
+            self.aux_fired[trig] = True
+            for i in trig:
+                bba = bbas[i]
+                if bba is not None and not bba.halted:
+                    bba.on_aux_quorum()
+
     def batch_vote(
         self,
         sender: str,

@@ -127,6 +127,7 @@ class ChannelNetwork:
         seed: Optional[int] = None,
         queue_capacity: int = 1_000_000,
         delivery_columnar: bool = False,
+        wave_routing: bool = False,
     ):
         # seed=None -> FIFO delivery; seed=int -> seeded random-order
         # delivery (the adversarial asynchronous scheduler from
@@ -155,6 +156,14 @@ class ChannelNetwork:
         self._columnar = delivery_columnar
         self._decode_memo = FrameDecodeMemo() if delivery_columnar else None
         self._unprepared = 0  # pending entries awaiting a wave pass
+        # Wave-routed ingest (Config.wave_routing): one step() drains
+        # the whole prepared wave, bucketing verified frames per
+        # receiver, and hands each receiver its bundle in ONE
+        # serve_wave call (protocol.router demuxes it into typed
+        # columns) instead of one serve_request per frame.  Handlers
+        # without serve_wave — and frames a mounted fault_filter must
+        # see per-frame — fall back to the scalar chain.
+        self._wave_routing = wave_routing and delivery_columnar
         # network-wide delivery counters (the per-epoch numbers
         # bench.py sections and perfgate gate on; per-endpoint twins
         # live on ChannelEndpoint for Metrics.snapshot)
@@ -409,8 +418,111 @@ class ChannelNetwork:
             for it, msg, ok in zip(good, msgs, oks):
                 it[4] = (msg, True) if ok else (None, "bad_mac")
 
+    def _step_wave(self) -> bool:
+        """Wave-routing delivery (Config.wave_routing): ONE step
+        drains the entire pending queue — one message wave, everything
+        the previous handler turns posted — bucketing verified frames
+        per receiver in scheduler pop order, then hands each receiver
+        its bundle in a single ``serve_wave`` call (the WaveRouter
+        demuxes it into typed ingest columns; one batch handler
+        dispatch per message kind).  Receivers fire in sorted-id order
+        (the idle_phase discipline); messages their handlers post form
+        the NEXT wave.  Frames a mounted fault_filter must see — and
+        frames the wave pass skipped (crashed/severed at prepare time)
+        — decode and verify through the per-frame scalar path, but
+        still JOIN the receiver's wave, so the router seam stays
+        exercised under wire-fault schedules."""
+        if not self._pending:
+            return False
+        if self.fault_filter is None and self._unprepared:
+            self._prepare_wave()
+        waves: Dict[str, List[Message]] = {}
+        while self._pending:
+            if self._rng is None:
+                item = self._pending.popleft()
+            else:
+                idx = self._rng.randrange(len(self._pending))
+                item = self._pending[idx]
+                self._pending[idx] = self._pending[-1]
+                self._pending.pop()
+            sender, receiver, wire, prefiltered, prepared = item
+            if prepared is None and self._unprepared > 0:
+                self._unprepared -= 1
+            if receiver in self._crashed or sender in self._crashed:
+                continue
+            if (sender, receiver) in self._partitions:
+                continue
+            ep = self._endpoints.get(receiver)
+            if ep is None:
+                continue
+            if prepared is not None and self.fault_filter is None:
+                # cached pre-wave verdict — only usable while NO
+                # filter is mounted: a filter mounted mid-run (with
+                # prepared frames still in flight) must see and
+                # re-verify the exact delivered bytes, exactly like
+                # the scalar arm re-filters prepared entries
+                msg, verdict = prepared
+                if verdict is not True:
+                    ep.rejected += 1
+                    self._trace_rejected(ep, sender, verdict)
+                    continue
+            else:
+                if self.fault_filter is not None and not prefiltered:
+                    maybe = self.fault_filter(sender, receiver, wire)
+                    if maybe is None:
+                        continue
+                    if isinstance(maybe, list):
+                        if not maybe:
+                            continue
+                        wire = maybe[0]
+                        # injected duplicates re-enter pending (never
+                        # re-filtered); the drain loop folds them into
+                        # this wave's tail — dedup absorbs them like
+                        # any replay
+                        for extra in maybe[1:]:
+                            if len(self._pending) < self._queue_capacity:
+                                self._pending.append(
+                                    [sender, receiver, extra, True, None]
+                                )
+                                self._unprepared += 1
+                    else:
+                        wire = maybe
+                try:
+                    msg, signing_prefix = decode_frame(
+                        wire, payload_memo=self._payload_memo
+                    )
+                except ValueError:
+                    ep.rejected += 1
+                    self._trace_rejected(ep, sender, "undecodable")
+                    continue
+                ep.frames_decoded += 1
+                self.frames_decoded += 1
+                ep.mac_verify_batches += 1
+                self.mac_verify_calls += 1
+                if not ep.auth.verify_wire(msg, signing_prefix):
+                    ep.rejected += 1
+                    self._trace_rejected(ep, sender, "bad_mac")
+                    continue
+            ep.delivered += 1
+            wave = waves.get(receiver)
+            if wave is None:
+                waves[receiver] = [msg]
+            else:
+                wave.append(msg)
+        for receiver in sorted(waves):
+            ep = self._endpoints.get(receiver)
+            serve_wave = getattr(ep.handler, "serve_wave", None)
+            if serve_wave is not None:
+                serve_wave(waves[receiver])
+            else:
+                for m in waves[receiver]:
+                    # handler without wave ingest: per-frame fallback
+                    ep.handler.serve_request(m)  # staticcheck: allow[DET004] non-wave fallback
+        return True
+
     def step(self) -> bool:
-        """Deliver one message; returns False if none pending.
+        """Deliver one message (or, in wave-routing mode, one whole
+        wave); returns False if none pending.
 
         Delivery order: FIFO without a seed, seeded-uniform-random with
         one — every run with the same seed replays the identical
@@ -423,6 +535,8 @@ class ChannelNetwork:
         messages appear) — exactly what ``run()`` does — or buffered
         work strands and the protocol stalls without error.
         """
+        if self._wave_routing:
+            return self._step_wave()
         columnar = self._columnar and self.fault_filter is None
         if columnar and self._unprepared:
             self._prepare_wave()
@@ -454,7 +568,7 @@ class ChannelNetwork:
                     self._trace_rejected(ep, sender, verdict)
                     continue
                 ep.delivered += 1
-                ep.handler.serve_request(msg)
+                ep.handler.serve_request(msg)  # staticcheck: allow[DET004] scalar comparison arm
                 return True
             if self.fault_filter is not None and not prefiltered:
                 maybe = self.fault_filter(sender, receiver, wire)
@@ -495,7 +609,7 @@ class ChannelNetwork:
                 self._trace_rejected(ep, sender, "bad_mac")
                 continue
             ep.delivered += 1
-            ep.handler.serve_request(msg)
+            ep.handler.serve_request(msg)  # staticcheck: allow[DET004] scalar comparison arm
             return True
         return False
 
@@ -525,7 +639,9 @@ class ChannelNetwork:
         self, max_steps: int = 10_000_000, deadline_s: Optional[float] = None
     ) -> int:
         """Deliver until quiescent (handlers may enqueue more while we
-        drain).  Returns the number of messages delivered.
+        drain).  Returns the number of delivery steps — one per
+        message, or one per WAVE in wave-routing mode (``max_steps``
+        bounds the same unit).
 
         Quiescence is two-level: when the pending queue drains, every
         endpoint gets its idle callback (running deferred crypto and

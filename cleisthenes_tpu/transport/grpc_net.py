@@ -67,6 +67,7 @@ class GrpcConnection:
         conn_id: Optional[str] = None,
         on_close: Optional[Callable[["GrpcConnection"], None]] = None,
         delivery_columnar: bool = False,
+        wave_routing: bool = False,
     ) -> None:
         self._inbound = inbound
         self._auth = auth
@@ -81,6 +82,11 @@ class GrpcConnection:
         # queue's backlog per pass — one message wave — and MACs it
         # through ONE Authenticator.verify_wire_many call.
         self._columnar = delivery_columnar
+        # Config.wave_routing: the verified wave dispatches as ONE
+        # handler call (SerialDispatcher.serve_wave — one actor
+        # mailbox entry per wave, not N) instead of one serve_request
+        # per frame.  Rides the columnar verify loop.
+        self._wave_routing = wave_routing and delivery_columnar
         self.delivered = 0
         self.rejected = 0
         # delivery-plane counters (Metrics.snapshot()["transport"])
@@ -199,7 +205,7 @@ class GrpcConnection:
                 self.delivered += 1
                 handler = self._handler
                 if handler is not None:
-                    handler.serve_request(msg)
+                    handler.serve_request(msg)  # staticcheck: allow[DET004] scalar comparison arm
         except Exception:  # staticcheck: allow[ERR001] finally closes the conn
             pass  # stream broken: fall through to close
         finally:
@@ -290,14 +296,28 @@ class GrpcConnection:
                         batch_width=len(msgs),
                     )
                 handler = self._handler
+                good: List[Message] = []
                 for msg, ok in zip(msgs, oks):
                     if not ok:
                         self.rejected += 1
                         self._trace_rejected("bad_mac")
                         continue
                     self.delivered += 1
-                    if handler is not None:
-                        handler.serve_request(msg)
+                    good.append(msg)
+                if not good or handler is None:
+                    continue
+                serve_wave = (
+                    getattr(handler, "serve_wave", None)
+                    if self._wave_routing
+                    else None
+                )
+                if serve_wave is not None:
+                    # one actor message per wave: the dispatcher's
+                    # mailbox carries the whole verified burst
+                    serve_wave(good)
+                else:
+                    for msg in good:
+                        handler.serve_request(msg)  # staticcheck: allow[DET004] scalar arm
         finally:
             self.close()
 
@@ -338,11 +358,13 @@ class GrpcServer:
         auth: Optional[Authenticator] = None,
         capacity: int = DEFAULT_CHANNEL_CAPACITY,
         delivery_columnar: bool = False,
+        wave_routing: bool = False,
     ) -> None:
         self.addr = addr
         self._auth = auth or NullAuthenticator()
         self._capacity = capacity
         self._delivery_columnar = delivery_columnar
+        self._wave_routing = wave_routing
         self._on_conn: Optional[ConnHandler] = None
         self._on_err: Optional[ErrHandler] = None
         self._server: Optional[grpc.Server] = None
@@ -403,6 +425,7 @@ class GrpcServer:
             capacity=self._capacity,
             on_close=lambda c: (self._remove_conn(c), context.cancel()),
             delivery_columnar=self._delivery_columnar,
+            wave_routing=self._wave_routing,
         )
         with self._lock:
             self._conns.append(conn)
@@ -470,9 +493,11 @@ class GrpcClient:
         self,
         auth: Optional[Authenticator] = None,
         delivery_columnar: bool = False,
+        wave_routing: bool = False,
     ):
         self._auth = auth or NullAuthenticator()
         self._delivery_columnar = delivery_columnar
+        self._wave_routing = wave_routing
         self._channels: List[grpc.Channel] = []
 
     def dial(self, opts: DialOpts) -> GrpcConnection:
@@ -499,6 +524,7 @@ class GrpcClient:
             capacity=opts.capacity,
             conn_id=opts.conn_id,
             delivery_columnar=self._delivery_columnar,
+            wave_routing=self._wave_routing,
         )
         call = multi(conn.outbound())
         conn._inbound = call

@@ -252,6 +252,26 @@ def render_prometheus(targets: Sequence[ObsTarget]) -> str:
             labels,
             int(transport["mac_verify_batches"]),
         )
+        # wave-routed ingest counters (always present — zeroed on the
+        # scalar routing arm per the schema-stability rule)
+        router = snap["router"]
+        exp.add(
+            exp.family(
+                "router_handler_dispatches_total", "counter",
+                "batch handler invocations crossing the router seam "
+                "(one per payload scalar; one per kind per wave routed)",
+            ),
+            labels,
+            int(router["handler_dispatches"]),
+        )
+        exp.add(
+            exp.family(
+                "router_waves_total", "counter",
+                "delivery waves demuxed by the wave router",
+            ),
+            labels,
+            int(router["waves_routed"]),
+        )
         for peer, ph in snap.get("transport_health", {}).items():
             plabels = {**labels, "peer": peer}
             exp.add(

@@ -217,6 +217,15 @@ class Metrics:
         # ciphertext ordering committed (the ordered frontier's tally;
         # settlement lands in epochs_committed as before)
         self.epochs_ordered = Counter()
+        # wave-routed ingest (Config.wave_routing): batch handler
+        # invocations crossing the router seam into protocol logic
+        # (ACS/RBC/BBA/dec-share entry points).  The scalar routing
+        # arm counts one per payload; the wave arm counts one per
+        # (message kind, delivery wave) — DETERMINISTIC for a seeded
+        # schedule, the counter perfgate gates like hub dispatches.
+        self.handler_dispatches = Counter()
+        # delivery waves the router demuxed (0 on the scalar arm)
+        self.waves_routed = Counter()
         self.epoch_latency = Histogram()  # seconds, propose -> commit
         self.acs_latency = Histogram()
         self.decrypt_latency = Histogram()
@@ -392,6 +401,14 @@ class Metrics:
             frontiers["settled_frontier"] = settled
             frontiers["decrypt_lag_epochs"] = max(0, ordered - settled)
         out["frontiers"] = frontiers
+        # wave-routing block: ALWAYS present with every key, zeroed on
+        # the scalar arm / bare nodes (the PR-9 schema-stability rule
+        # — scrapers and the timeseries sampler must never see a key
+        # appear or disappear between snapshots)
+        out["router"] = {
+            "handler_dispatches": self.handler_dispatches.value,
+            "waves_routed": self.waves_routed.value,
+        }
         # every transport key is ALWAYS present (zeroed when no frame
         # counters registered): scrapers and the timeseries sampler
         # must never see a key appear/disappear between snapshots —

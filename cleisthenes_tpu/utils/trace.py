@@ -66,6 +66,9 @@ CATEGORIES = frozenset(
         # from "tpke" so open->ordered critical paths show exactly the
         # mass that LEFT them
         "hub",  # CryptoHub batched-dispatch flushes
+        "router",  # wave-routed ingest demux (protocol.router): one
+        # "route" span per delivery wave, args carry frame/payload/
+        # dispatch counts — the handler-dispatch amortization record
         "transport",  # envelope coalescing, waves, queue depth
         "ledger",  # WAL appends / checkpoints
         "catchup",  # state-transfer requests/serves/adopts

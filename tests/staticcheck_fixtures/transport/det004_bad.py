@@ -1,0 +1,16 @@
+"""Known-bad DET004 fixture: a transport reader loop dispatching
+per-frame into the handler — the exact per-payload ingest chain the
+wave router (ISSUE 10) replaced.  Both the serve_request form (a
+Handler boundary) and a direct handle_message call (reaching into the
+protocol plane from transport code) must gate."""
+
+
+def read_loop(inbound, handler, decode):
+    for wire in inbound:
+        msg = decode(wire)
+        handler.serve_request(msg)  # BAD:DET004
+
+
+def deliver_decoded(msgs, node):
+    for msg in msgs:
+        node.handle_message(msg.sender_id, msg.payload)  # BAD:DET004

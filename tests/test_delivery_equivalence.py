@@ -107,15 +107,21 @@ def test_transport_metrics_surface_delivery_counters():
     assert snap["delivered"] > 0
 
 
-def _grpc_epoch0_bodies(columnar: bool) -> tuple:
-    """(per-node epoch-0 bodies, one host's transport snapshot) from a
-    4-node run over real localhost gRPC under the given arm."""
+def _grpc_epoch0_bodies(
+    columnar: bool, wave_routing: bool = True
+) -> tuple:
+    """(per-node epoch-0 bodies, one host's metrics snapshot) from a
+    4-node run over real localhost gRPC under the given arms."""
     from cleisthenes_tpu.protocol.honeybadger import setup_keys
     from cleisthenes_tpu.transport.host import ValidatorHost
 
     n = 4
     cfg = Config(
-        n=n, batch_size=8, seed=78, delivery_columnar=columnar
+        n=n,
+        batch_size=8,
+        seed=78,
+        delivery_columnar=columnar,
+        wave_routing=wave_routing,
     )
     ids = [f"node{i}" for i in range(n)]
     keys = setup_keys(cfg, ids, seed=56)
@@ -136,7 +142,7 @@ def _grpc_epoch0_bodies(columnar: bool) -> tuple:
             h.propose()
         first = {i: h.wait_commit(timeout=60) for i, h in hosts.items()}
         assert {e for e, _ in first.values()} == {0}
-        snap = hosts[ids[0]].node.metrics.snapshot()["transport"]
+        snap = hosts[ids[0]].node.metrics.snapshot()
         return [encode_batch_body(0, b) for _, b in first.values()], snap
     finally:
         for h in hosts.values():
@@ -157,8 +163,9 @@ def test_scalar_vs_columnar_identical_ledgers_grpc():
     assert col[0] == sca[0], (
         "columnar vs scalar gRPC runs committed different epoch-0 bytes"
     )
-    assert col_snap["mac_verify_batches"] > 0
-    assert col_snap["mac_verify_batches"] <= col_snap["frames_decoded"]
+    transport = col_snap["transport"]
+    assert transport["mac_verify_batches"] > 0
+    assert transport["mac_verify_batches"] <= transport["frames_decoded"]
 
 
 # Prints one line digesting the ledger bytes AND the columnar delivery
@@ -187,14 +194,25 @@ for nid in cluster.ids:
     for epoch, batch in enumerate(cluster.nodes[nid].committed_batches):
         h.update(encode_batch_body(epoch, batch))
 d = cluster.net.delivery_stats()
+assert Config().wave_routing is True  # the router is the default arm
+dispatches = sum(
+    cluster.nodes[nid].metrics.handler_dispatches.value
+    for nid in cluster.ids
+)
+waves = sum(
+    cluster.nodes[nid].metrics.waves_routed.value for nid in cluster.ids
+)
 print(
-    "DELIVERY_DIGEST=%s decoded=%d verifies=%d hits=%d misses=%d"
+    "DELIVERY_DIGEST=%s decoded=%d verifies=%d hits=%d misses=%d "
+    "dispatches=%d waves=%d"
     % (
         h.hexdigest(),
         d["frames_decoded"],
         d["mac_verifies"],
         d["decode_memo_hits"],
         d["decode_memo_misses"],
+        dispatches,
+        waves,
     )
 )
 """
@@ -230,6 +248,101 @@ def test_delivery_ordering_identical_across_hash_seeds():
         f"  {a}\n  {b}\n-> hash-order iteration is leaking into the "
         "wave-prepare / EchoBank path (see staticcheck DET002)"
     )
+
+
+# ---------------------------------------------------------------------------
+# wave routing (ISSUE 10): scalar vs wave-routed ingest
+# ---------------------------------------------------------------------------
+
+
+def _routing_run(wave_routing: bool) -> tuple:
+    """(ledger digest, depth, cluster-wide handler dispatches, waves
+    routed) for one seeded 4-node channel run under the given ROUTING
+    arm (delivery_columnar stays on for both — the router rides it)."""
+    cluster = SimulatedCluster(
+        config=Config(
+            n=4,
+            batch_size=8,
+            seed=4041,
+            delivery_columnar=True,
+            wave_routing=wave_routing,
+        ),
+        seed=4041,
+        key_seed=23,
+    )
+    for i in range(24):
+        cluster.submit(b"rtr-tx-%04d" % i)
+    cluster.run_epochs()
+    depth = cluster.assert_agreement()
+    h = hashlib.sha256()
+    for nid in cluster.ids:
+        for epoch, batch in enumerate(
+            cluster.nodes[nid].committed_batches
+        ):
+            h.update(encode_batch_body(epoch, batch))
+    dispatches = sum(
+        cluster.nodes[nid].metrics.handler_dispatches.value
+        for nid in cluster.ids
+    )
+    waves = sum(
+        cluster.nodes[nid].metrics.waves_routed.value
+        for nid in cluster.ids
+    )
+    return h.hexdigest(), depth, dispatches, waves
+
+
+def test_scalar_vs_wave_routing_identical_ledgers_channel():
+    wav = _routing_run(wave_routing=True)
+    sca = _routing_run(wave_routing=False)
+    assert wav[1] >= 2 and sca[1] >= 2  # both actually committed
+    assert wav[0] == sca[0], (
+        "wave-routed ingest committed different ledger bytes than the "
+        f"scalar routing arm:\n  wave:   {wav}\n  scalar: {sca}"
+    )
+    # the refactor's entire point: one batch handler invocation per
+    # (kind, wave) instead of one Python call chain per payload —
+    # the deterministic counter must drop by a real factor, and the
+    # router must actually have demuxed waves
+    assert sca[2] >= 3 * wav[2], (wav, sca)
+    assert wav[3] > 0
+    assert sca[3] == 0  # scalar arm never routes a wave
+
+
+def test_router_metrics_schema_zeroed_on_scalar_arm():
+    """snapshot()["router"] keys are present on BOTH arms (the PR-9
+    schema rule) and zeroed on the scalar one."""
+    for wave in (True, False):
+        cluster = SimulatedCluster(
+            config=Config(
+                n=4, batch_size=8, seed=7, wave_routing=wave
+            ),
+            seed=7,
+            key_seed=2,
+        )
+        for i in range(8):
+            cluster.submit(b"rs-%04d" % i)
+        cluster.run_epochs()
+        snap = cluster.nodes[cluster.ids[0]].metrics.snapshot()["router"]
+        assert set(snap) == {"handler_dispatches", "waves_routed"}
+        assert snap["handler_dispatches"] > 0  # both arms dispatch
+        assert (snap["waves_routed"] > 0) == wave
+
+
+def test_scalar_vs_wave_routing_identical_ledgers_grpc():
+    """Same roster, same submissions, real sockets + the dispatcher's
+    wave mailbox: the wave-routed and scalar routing arms must commit
+    byte-identical epoch-0 batches, and the wave arm must actually
+    route waves."""
+    wav, wav_snap = _grpc_epoch0_bodies(columnar=True, wave_routing=True)
+    sca, _ = _grpc_epoch0_bodies(columnar=True, wave_routing=False)
+    assert all(b == wav[0] for b in wav)
+    assert all(b == sca[0] for b in sca)
+    assert wav[0] == sca[0], (
+        "wave vs scalar routing gRPC runs committed different "
+        "epoch-0 bytes"
+    )
+    assert wav_snap["router"]["waves_routed"] > 0
+    assert wav_snap["router"]["handler_dispatches"] > 0
 
 
 # ---------------------------------------------------------------------------
@@ -389,6 +502,59 @@ def test_epoch_sprayer_coalition_columnar_bank():
 # ---------------------------------------------------------------------------
 
 
+# ---------------------------------------------------------------------------
+# PR-4 semantic coalitions against the wave router (ISSUE 10)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.faults
+def test_equivocator_coalition_wave_router():
+    """Equivocating per-receiver roots through the ROUTER's echo/ready
+    columns: the per-(root, instance) EchoBank counting must keep the
+    quorums separate when whole waves land in one dispatch."""
+    from cleisthenes_tpu.protocol.byzantine import make_behavior
+
+    assert Config().wave_routing is True  # the arm under test
+    behaviors = {"node003": make_behavior("equivocator", seed=41)}
+    depth = _drive_coalition(behaviors, n=4, seed=23)
+    assert depth >= 1
+    assert behaviors["node003"].rewrites > 0, "adversary never lied"
+
+
+@pytest.mark.faults
+def test_epoch_sprayer_coalition_wave_router():
+    """EpochSprayer's far-future spam exercises the router's
+    column-granular demux window (no state minted outside it) and the
+    per-payload CATCHUP renudge cadence."""
+    from cleisthenes_tpu.protocol.byzantine import (
+        CompositeBehavior,
+        make_behavior,
+    )
+
+    behaviors = {
+        "node003": CompositeBehavior(
+            [
+                make_behavior("epoch_sprayer", seed=42),
+                make_behavior("split_voter", seed=43),
+            ]
+        )
+    }
+    depth = _drive_coalition(behaviors, n=4, seed=29)
+    assert depth >= 1
+
+
+@pytest.mark.faults
+def test_selective_mute_coalition_wave_router():
+    """SelectiveMute starves chosen links: waves arrive asymmetric
+    per receiver, so the router's per-receiver bundles must still
+    drive the honest quorums to agreement."""
+    from cleisthenes_tpu.protocol.byzantine import make_behavior
+
+    behaviors = {"node003": make_behavior("selective_mute", seed=44)}
+    depth = _drive_coalition(behaviors, n=4, seed=31)
+    assert depth >= 1
+
+
 @pytest.mark.faults
 def test_fuzz_band_columnar_delivery():
     """20 sampled composite schedules (semantic behaviors x wire
@@ -412,4 +578,50 @@ def test_fuzz_deep_sweep_columnar_delivery():
     assert Config().delivery_columnar is True
     for seed in range(320, 520):
         v = run_schedule(sample_schedule(seed))
+        assert v is None, f"seed {seed}: {v}"
+
+
+@pytest.mark.faults
+def test_fuzz_band_wave_routing():
+    """20 sampled composite schedules against the WAVE ROUTER (the
+    fuzzer's default arm since wave_routing defaults True) — a seed
+    band disjoint from the ci.sh smoke band and the PR-9 delivery
+    band, so the router seam adds coverage instead of re-running it.
+    Wire-fault schedules mount a fault_filter, which on the channel
+    transport keeps per-frame decode/verify but still routes the
+    verified wave — the seam is exercised under tampering too."""
+    from tools.fuzz import run_schedule, sample_schedule
+
+    assert Config().wave_routing is True  # the fuzzer's arm
+    for seed in range(520, 540):
+        v = run_schedule(sample_schedule(seed))
+        assert v is None, f"seed {seed}: {v}"
+
+
+@pytest.mark.slow
+@pytest.mark.faults
+def test_fuzz_deep_sweep_wave_routing():
+    """The 200-seed slow band on the wave-routing arm."""
+    from tools.fuzz import run_schedule, sample_schedule
+
+    assert Config().wave_routing is True
+    for seed in range(540, 740):
+        v = run_schedule(sample_schedule(seed))
+        assert v is None, f"seed {seed}: {v}"
+
+
+@pytest.mark.faults
+def test_fuzz_band_scalar_routing_pinned():
+    """Wave routing drains a whole wave before any handler runs, so
+    the scalar arm's finer per-message interleavings (a new message
+    overtaking older pending ones mid-wave) are a schedule space the
+    default arm can no longer reach — this band stays PINNED to
+    wave_routing=False so the adversarial scheduler keeps exploring
+    it (the schedule key round-trips through repro files)."""
+    from tools.fuzz import run_schedule, sample_schedule
+
+    for seed in range(740, 760):
+        s = sample_schedule(seed)
+        s["wave_routing"] = False
+        v = run_schedule(s)
         assert v is None, f"seed {seed}: {v}"

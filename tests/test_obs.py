@@ -208,6 +208,10 @@ def _golden_target() -> ObsTarget:
     m.settle_lag_latency.observe(0.02)
     m.epochs_ordered.inc(3)
     m.set_frontiers(lambda: (3, 2))
+    # wave-routed ingest counters (ISSUE 10): zeroed keys on every
+    # path; pinned nonzero so the golden scrape covers the families
+    m.handler_dispatches.inc(12)
+    m.waves_routed.inc(4)
     m.tx_per_sec = lambda: 1.5  # pin the one wall-clock-derived gauge
     m.set_transport_stats(
         lambda: {

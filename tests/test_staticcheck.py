@@ -72,6 +72,11 @@ BAD_FIXTURES = [
     # from protocol/ outside hub.py gates, so the wave refactor can't
     # silently erode back to scalar dispatch
     "protocol/det003_bad.py",
+    # the wave-router seam (ISSUE 10): per-frame serve_request /
+    # handle_message dispatch from transport code still gates — the
+    # router's one-dispatch-per-kind-per-wave discipline can't
+    # silently erode back to one Python call chain per payload
+    "transport/det004_bad.py",
     "protocol/conc001_bad.py",
     "transport/conc002_bad.py",
     "protocol/err001_bad.py",
@@ -80,6 +85,7 @@ GOOD_FIXTURES = [
     "protocol/det001_good.py",
     "protocol/det002_good.py",
     "protocol/det003_good.py",
+    "transport/det004_good.py",
     "protocol/conc001_good.py",
     "transport/conc002_good.py",
     "protocol/err001_good.py",
@@ -166,6 +172,7 @@ def test_rule_catalog_registered():
         "DET001",
         "DET002",
         "DET003",
+        "DET004",
         "CONC001",
         "CONC002",
         "ERR001",

@@ -109,6 +109,17 @@ print(json.dumps({
     "decrypt_lag_p95_ms": (
         round(lag_p95 * 1000.0, 3) if lag_p95 is not None else None
     ),
+    # wave-routed ingest (ISSUE 10): cluster-wide batch handler
+    # invocations, deterministic for the seeded schedule (null on
+    # refs that predate the router)
+    "handler_dispatches": (
+        sum(
+            hb.metrics.handler_dispatches.value
+            for hb in cluster.nodes.values()
+        )
+        if hasattr(m, "handler_dispatches")
+        else None
+    ),
 }))
 """
 
@@ -215,6 +226,16 @@ def run_ab(
         _ratio(h.get("ordered_epoch_p50_ms"), b.get("epoch_p50_ms"))
         for h, b in zip(head, base)
     ]
+    # like-for-like ordered frontier: HEAD's ordered p50 vs the BASE
+    # arm's own ordered p50 (null when the base ref predates the
+    # two-frontier split) — the cleanest signal for PRs that target
+    # the open->ordered window itself (delivery/routing work)
+    ordered_vs_ordered = [
+        _ratio(
+            h.get("ordered_epoch_p50_ms"), b.get("ordered_epoch_p50_ms")
+        )
+        for h, b in zip(head, base)
+    ]
 
     def med(rs):
         valid = [r for r in rs if r is not None]
@@ -242,10 +263,12 @@ def run_ab(
         "pair_epoch_wall_ratios": wall_ratios,
         "pair_epoch_p50_ratios": p50_ratios,
         "pair_ordered_p50_ratios": ordered_ratios,
+        "pair_ordered_vs_ordered_ratios": ordered_vs_ordered,
         # < 1.0 = HEAD faster, same box, same moment
         "epoch_wall_ratio_median": med(wall_ratios),
         "epoch_p50_ratio_median": med(p50_ratios),
         "ordered_p50_ratio_median": med(ordered_ratios),
+        "ordered_vs_ordered_ratio_median": med(ordered_vs_ordered),
     }
 
 
@@ -263,6 +286,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         "--keep-worktree", action="store_true",
         help="leave .abench/<sha> in place for re-runs",
     )
+    ap.add_argument(
+        "--no-trend", action="store_true",
+        help="do not append the paired report to BENCH_TREND.jsonl",
+    )
+    ap.add_argument(
+        "--trend", default=str(REPO_ROOT / "BENCH_TREND.jsonl"),
+        help="trend JSONL path the report appends to",
+    )
     args = ap.parse_args(argv)
     report = run_ab(
         args.base_ref,
@@ -274,8 +305,35 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         keep_worktree=args.keep_worktree,
         progress=lambda msg: print(msg, file=sys.stderr, flush=True),
     )
+    if not args.no_trend:
+        # paired A/B reports join the durable trend: the same-box
+        # ratio history is the number cross-round comparisons can
+        # actually trust (the r05 cross-box lesson)
+        from tools.perfgate import append_record
+
+        record = dict(report)
+        record["kind"] = "abench_paired"
+        record["ts"] = _utc_stamp()
+        record["fingerprint"] = {
+            "kind": "abench_paired",
+            "base_ref": args.base_ref,
+            "n": args.n,
+            "batch": args.batch,
+            "epochs": args.epochs,
+            "seed": args.seed,
+        }
+        try:
+            append_record(args.trend, record)
+        except OSError:
+            pass  # a report must never sink on trend bookkeeping
     print(json.dumps(report))
     return 0
+
+
+def _utc_stamp() -> str:
+    import time
+
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
 
 
 if __name__ == "__main__":

@@ -191,6 +191,12 @@ def _build_cluster(schedule: dict, trace: bool) -> SimulatedCluster:
         batch_size=schedule["batch_size"],
         seed=schedule["seed"],
         trace=trace,
+        # schedules may pin the routing arm: wave_routing drains a
+        # whole wave before any handler runs, so the scalar arm's
+        # finer per-message interleavings are a schedule space of
+        # their own — a band stays pinned to it (the key round-trips
+        # through repro files like every other schedule field)
+        wave_routing=schedule.get("wave_routing", True),
     )
     cluster = SimulatedCluster(
         n=schedule["n"],

@@ -173,6 +173,10 @@ def append_bench_trend(result: Dict, path: str = str(DEFAULT_TREND)) -> int:
                     "decode_memo_hit_rate": side.get(
                         "decode_memo_hit_rate"
                     ),
+                    # wave-routed ingest (ISSUE 10)
+                    "handler_dispatches_per_epoch": side.get(
+                        "handler_dispatches_per_epoch"
+                    ),
                 }
                 append_record(path, record)
                 appended += 1
@@ -256,6 +260,11 @@ def run_sample(
             # MEAN (scalar: one decode+verify per frame; columnar:
             # memoized decode, one verify per wave) — same rule
             "delivery_columnar": bool(cfg.delivery_columnar),
+            # the routing arm changes what handler_dispatches MEANS
+            # (scalar: one per payload; wave: one per kind per wave)
+            # — a mode flip must never gate against the other mode's
+            # trend
+            "wave_routing": bool(cfg.wave_routing),
         },
         "epoch_p50_ms": round(p50 * 1000.0, 3),
         "epoch_p95_ms": round(p95 * 1000.0, 3),
@@ -289,6 +298,17 @@ def run_sample(
             round(dstats["decode_memo_hits"] / probes, 4)
             if probes
             else 0.0
+        ),
+        # wave-routed ingest (ISSUE 10): batch handler invocations
+        # crossing the router seam, cluster-wide — deterministic for
+        # the seeded schedule, gated like hub_dispatches (a routing
+        # regression — columns stop forming, the router falls back to
+        # per-payload dispatch — fails here with zero noise)
+        "handler_dispatches": int(
+            sum(
+                hb.metrics.handler_dispatches.value
+                for hb in cluster.nodes.values()
+            )
         ),
     }
 
@@ -345,6 +365,7 @@ def compare(
         ("hub_dispatches", "hub dispatch"),
         ("frames_decoded", "frame-decode"),
         ("mac_verifies", "MAC-verify"),
+        ("handler_dispatches", "handler-dispatch"),
     ):
         history = [
             r[counter] for r in trend if isinstance(r.get(counter), int)

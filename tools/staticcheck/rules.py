@@ -301,6 +301,55 @@ class Det003HubColumnarSeam:
 
 
 # ---------------------------------------------------------------------------
+# DET004: protocol ingest must cross the wave-router seam per WAVE
+# ---------------------------------------------------------------------------
+#
+# The wave-routed ingest refactor (ISSUE 10) moved the inbound handler
+# boundary to wave granularity: transports hand a delivery wave's
+# verified frames to the handler in ONE serve_wave call, and the
+# WaveRouter makes one batch dispatch per message kind — replacing the
+# per-payload HoneyBadger.handle_message -> ACS -> RBC/BBA chain that
+# owned the transport stage share after PR 9.  A per-frame
+# ``handler.serve_request(...)`` / ``x.handle_message(...)`` call from
+# transport/ code silently erodes that seam back to one Python call
+# chain per payload — the exact regression the router removed.  The
+# sanctioned sites (the scalar byte-equivalence comparison arm behind
+# Config.wave_routing=False, local self-delivery short-circuits, and
+# the non-wave-handler fallbacks) carry allow[DET004] pragmas with
+# justifications.
+
+_DET004_CALLS = frozenset(("serve_request", "handle_message"))
+
+
+@rule
+class Det004WaveIngestSeam:
+    id = "DET004"
+    doc = (
+        "no per-frame handler dispatch (serve_request/handle_message) "
+        "from transport/ outside the wave-router seam; buffer the "
+        "wave and hand it over in one serve_wave call"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        parts = ctx.relpath.split("/")
+        if "transport" not in parts:
+            return
+        for node in ast.walk(ctx.tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _DET004_CALLS
+            ):
+                yield ctx.finding(
+                    self.id,
+                    node,
+                    f"per-frame {node.func.attr}() dispatch bypasses "
+                    "the wave-router seam; buffer the wave and hand "
+                    "it to the handler in one serve_wave call",
+                )
+
+
+# ---------------------------------------------------------------------------
 # CONC001: lock discipline for @guarded_by-annotated attributes
 # ---------------------------------------------------------------------------
 #

@@ -274,6 +274,12 @@ def summarize(doc: dict) -> dict:
         "frame_decodes": 0,
         "decode_memo_hits": 0,
         "mac_verify_batches": 0,
+        # wave-routed ingest (ISSUE 10): one router/route span per
+        # delivery wave; args carry the wave's payload count and the
+        # batch handler dispatches it collapsed to
+        "router_waves": 0,
+        "router_payloads": 0,
+        "router_dispatches": 0,
     }
     batch_widths: List[float] = []
     for ev in _analysis_events(doc):
@@ -302,6 +308,12 @@ def summarize(doc: dict) -> dict:
             width = args.get("batch_width")
             if isinstance(width, (int, float)):
                 batch_widths.append(float(width))
+        elif cat == "router" and ev["name"] == "route":
+            delivery["router_waves"] += 1
+            delivery["router_payloads"] += int(args.get("payloads", 0))
+            delivery["router_dispatches"] += int(
+                args.get("dispatches", 0)
+            )
     spans = {
         f"{cat}/{name}": {
             "n": len(durs),
