@@ -98,6 +98,13 @@ echo "== [8/9] fuzz smoke: semantic+wire schedule fuzzer, 20-seed band"
 # + trace artifact in FUZZ_OUT (cleaned only on success)
 FUZZ_OUT="$(mktemp -d /tmp/cleisthenes_fuzz_ci.XXXXXX)"
 JAX_PLATFORMS=cpu python -m tools.fuzz --seeds 0:20 --out "$FUZZ_OUT"
+# dynamic-membership band: the same composite schedules run ACROSS a
+# join/retire reshare ceremony and its activation boundary — ledger,
+# roster-version and key-material agreement must span the roster
+# change (the 200-seed deep sweep rides the slow tier,
+# tests/test_fuzz.py::test_fuzz_reconfig_deep_sweep)
+JAX_PLATFORMS=cpu python -m tools.fuzz --seeds 0:20 --reconfig \
+    --rounds 16 --out "$FUZZ_OUT"
 rm -rf "$FUZZ_OUT"
 
 if [[ "${CI_FAST:-0}" == "1" ]]; then

@@ -98,6 +98,11 @@ class Config:
       decrypt_lag_max: backpressure bound on ordered-ahead epochs
         (ordered frontier - settled frontier); also the settle-stall
         SLO watchdog's lag budget.
+      reconfig_lead: dynamic membership (protocol.reconfig): epochs
+        between the settlement completing a reshare ceremony and the
+        new roster's activation; must exceed decrypt_lag_max so the
+        activation boundary lands past every epoch the old roster
+        could already have ordered.
       delivery_columnar: columnar inbound delivery plane — wave-batched
         MAC verification + shared-prefix frame-decode memoization on
         both transports (see the field comment below).  False is the
@@ -190,6 +195,14 @@ class Config:
     # delaying settlement (share forgery) therefore stalls ordering
     # AT this bound, never unboundedly ahead of durable plaintext.
     decrypt_lag_max: int = 4
+    # Dynamic membership (protocol.reconfig): epochs between the
+    # SETTLEMENT that completes a reshare ceremony's qualified dealer
+    # set and the new roster's activation epoch.  Must exceed
+    # decrypt_lag_max: when the completing epoch settles, the ordered
+    # frontier is at most decrypt_lag_max ahead, so no epoch at or
+    # past the activation boundary can have been ordered under the
+    # OLD roster — the switch point is clean on every honest node.
+    reconfig_lead: int = 8
 
     def __post_init__(self) -> None:
         if self.n < 1:
@@ -245,6 +258,13 @@ class Config:
             raise ValueError(
                 f"decrypt_lag_max={self.decrypt_lag_max} must be >= 1 "
                 "(1 = order at most one epoch ahead of settlement)"
+            )
+        if self.reconfig_lead <= self.decrypt_lag_max:
+            raise ValueError(
+                f"reconfig_lead={self.reconfig_lead} must exceed "
+                f"decrypt_lag_max={self.decrypt_lag_max} (the roster "
+                "switch point must land past every epoch the old "
+                "roster could already have ordered)"
             )
         if self.mesh_shape is not None:
             from cleisthenes_tpu.parallel.mesh import validate_mesh_shape

@@ -33,6 +33,15 @@ three record magics:
   output map, so honest nodes' ordered logs are byte-identical —
   the cross-frontier fuzz invariant.
 
+  "RCFG" — roster switch (dynamic membership): u32 version | u64
+  activation_epoch | u32 n_members | per member (u32 id_len | id |
+  u32 ip_len | ip | u32 port) | u32 digest_len | key-material digest
+  — the durable witness of a finalized reshare ceremony, written
+  strictly before any epoch orders under the new roster.  Recovery
+  re-derives the ceremony from the replayed CLOG batches (the
+  RECONFIG and dealing transactions are ordinary committed txs) and
+  cross-checks the result against these records.
+
 A torn tail (crash mid-append) is detected by length/CRC and
 truncated away on open.  The fsync-on-commit policy is
 Config.ledger_fsync.
@@ -52,6 +61,7 @@ from cleisthenes_tpu.utils.determinism import guarded_by
 _MAGIC = b"CLOG"
 _MAGIC_CKPT = b"CCKP"
 _MAGIC_ORD = b"COrd"
+_MAGIC_RCFG = b"RCFG"
 
 
 def encode_batch_body(epoch: int, batch: Batch) -> bytes:
@@ -107,6 +117,67 @@ def decode_ordered_body(body: bytes) -> Tuple[int, Dict[str, bytes]]:
     if off != len(body):
         raise ValueError("trailing bytes in ordered record")
     return epoch, output
+
+
+def encode_reconfig_body(
+    version: int,
+    activation_epoch: int,
+    members: Sequence[Tuple[str, str, int]],
+    key_digest: bytes,
+) -> bytes:
+    """The RCFG record body: a committed roster switch — version,
+    activation epoch, the (id, ip, port) member table and the
+    key-material digest.  Written when a reshare ceremony finalizes,
+    BEFORE the first epoch ordered under the new roster, so crash
+    recovery replays the switch deterministically (the ceremony
+    re-derives from replayed CLOG batches; the RCFG record is the
+    durable witness recovery cross-checks against)."""
+    out: List[bytes] = [
+        struct.pack(">IQ", version, activation_epoch),
+        struct.pack(">I", len(members)),
+    ]
+    for mid, ip, port in members:
+        b_id = mid.encode("utf-8")
+        b_ip = ip.encode("utf-8")
+        out.append(struct.pack(">I", len(b_id)))
+        out.append(b_id)
+        out.append(struct.pack(">I", len(b_ip)))
+        out.append(b_ip)
+        out.append(struct.pack(">I", port))
+    out.append(struct.pack(">I", len(key_digest)))
+    out.append(key_digest)
+    return b"".join(out)
+
+
+def decode_reconfig_body(
+    body: bytes,
+) -> Tuple[int, int, List[Tuple[str, str, int]], bytes]:
+    off = 0
+    version, activation = struct.unpack_from(">IQ", body, off)
+    off += 12
+
+    def u32() -> int:
+        nonlocal off
+        (v,) = struct.unpack_from(">I", body, off)
+        off += 4
+        return v
+
+    members: List[Tuple[str, str, int]] = []
+    for _ in range(u32()):
+        id_len = u32()
+        mid = body[off : off + id_len].decode("utf-8")
+        off += id_len
+        ip_len = u32()
+        ip = body[off : off + ip_len].decode("utf-8")
+        off += ip_len
+        port = u32()
+        members.append((mid, ip, port))
+    dig_len = u32()
+    key_digest = body[off : off + dig_len]
+    off += dig_len
+    if off != len(body):
+        raise ValueError("trailing bytes in reconfig record")
+    return version, activation, members, key_digest
 
 
 def _encode_body(epoch: int, batch: Batch) -> bytes:
@@ -244,6 +315,7 @@ class BatchLog:
                 magic != _MAGIC
                 and magic != _MAGIC_CKPT
                 and magic != _MAGIC_ORD
+                and magic != _MAGIC_RCFG
             ):
                 return
             (body_len,) = struct.unpack_from(">I", data, off + 4)
@@ -259,6 +331,8 @@ class BatchLog:
                     _decode_body(body)
                 elif magic == _MAGIC_ORD:
                     decode_ordered_body(body)
+                elif magic == _MAGIC_RCFG:
+                    decode_reconfig_body(body)
                 else:
                     _decode_checkpoint_body(body)
             except (ValueError, struct.error, UnicodeDecodeError):
@@ -281,9 +355,10 @@ class BatchLog:
                 (self._last_ordered_epoch,) = struct.unpack_from(
                     ">Q", body, 0
                 )
-            else:
+            elif magic == _MAGIC_CKPT:
                 epoch, history = _decode_checkpoint_body(body)
                 self._last_checkpoint = (epoch, history)
+            # RCFG records are consumed via replay_reconfigs()
             good_end = end
         if good_end < len(data):  # torn/corrupt tail: drop it
             with open(self.path, "r+b") as fh:
@@ -353,6 +428,48 @@ class BatchLog:
                 "ledger", "wal_checkpoint", t0, epoch=epoch, bytes=len(rec)
             )
 
+    def append_reconfig(
+        self,
+        version: int,
+        activation_epoch: int,
+        members: Sequence[Tuple[str, str, int]],
+        key_digest: bytes,
+    ) -> None:
+        """Durably record a finalized roster switch (dynamic
+        membership): written when the reshare ceremony completes,
+        strictly BEFORE any epoch orders under the new roster."""
+        rec = _frame_record(
+            _MAGIC_RCFG,
+            encode_reconfig_body(
+                version, activation_epoch, members, key_digest
+            ),
+        )
+        tr = self.trace
+        t0 = 0.0 if tr is None else tr.now()
+        with self._lock:
+            self._append_record_locked(rec)
+        if tr is not None:
+            tr.complete(
+                "ledger",
+                "wal_reconfig",
+                t0,
+                version=version,
+                activation_epoch=activation_epoch,
+            )
+
+    def replay_reconfigs(
+        self,
+    ) -> Iterator[Tuple[int, int, List[Tuple[str, str, int]], bytes]]:
+        """All (version, activation_epoch, members, key_digest)
+        reconfig records, oldest first — recovery's cross-check that
+        the ceremony re-derived from the replayed batches matches what
+        the crashed process had durably switched to."""
+        with open(self.path, "rb") as fh:
+            data = fh.read()
+        for _end, magic, body in self._scan(data):
+            if magic == _MAGIC_RCFG:
+                yield decode_reconfig_body(body)
+
     def replay(self) -> Iterator[Tuple[int, Batch]]:
         """All committed (epoch, batch) records, oldest first
         (checkpoint records are skipped — see ``last_checkpoint``)."""
@@ -403,4 +520,6 @@ __all__ = [
     "decode_batch_body",
     "encode_ordered_body",
     "decode_ordered_body",
+    "encode_reconfig_body",
+    "decode_reconfig_body",
 ]

@@ -1,16 +1,24 @@
-"""Network roster: Address, Member, MemberMap.
+"""Network roster: Address, Member, MemberMap — and, since the
+dynamic-membership PR, the VERSIONED roster vocabulary.
 
 Mirrors reference member_map.go: ``Address{Ip, Port}``
 (member_map.go:12-19), ``Member{Id, Addr}`` (member_map.go:22-25), and
 the RWMutex-guarded id->member ``MemberMap`` with Members/Member/Add/Del
 (member_map.go:43-87).
+
+``RosterVersion`` / ``RosterSchedule`` are the dynamic-membership
+additions (docs/ARCHITECTURE.md "Dynamic membership"): a roster is no
+longer a construction-time constant but a VERSIONED value activating
+at an epoch boundary — every epoch-scoped structure resolves n/f/keys
+through ``RosterSchedule.version_for(epoch)`` instead of reading the
+construction-time ``Config``.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import threading
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from cleisthenes_tpu.utils.determinism import guarded_by
 
@@ -42,6 +50,105 @@ class Member:
     def address(self) -> Address:
         """Reference member_map.go:38."""
         return self.addr
+
+
+@dataclasses.dataclass(frozen=True)
+class RosterVersion:
+    """One activated (or pending) roster configuration.
+
+    ``activation_epoch``: the first epoch ORDERED under this roster —
+    the PR-8 ordered frontier is the switch point, so the boundary is
+    WAL-durable and identical at every honest node.  ``members`` is
+    the sorted member tuple (sorted order defines Shamir share
+    indices, exactly like the construction-time roster).
+    ``key_material_digest`` commits to the version's public threshold
+    key material (TPKE + coin master keys and verification-key
+    tables): every honest node derives the identical digest from the
+    committed ceremony, which makes key agreement a checkable
+    cross-node invariant (tools/fuzz.py reconfig band).
+    """
+
+    version: int
+    activation_epoch: int
+    members: Tuple[Member, ...]
+    key_material_digest: bytes = b""
+
+    def __post_init__(self) -> None:
+        ordered = tuple(sorted(self.members, key=lambda m: m.id))
+        if ordered != self.members:
+            object.__setattr__(self, "members", ordered)
+
+    @property
+    def member_ids(self) -> Tuple[str, ...]:
+        return tuple(m.id for m in self.members)
+
+    @property
+    def n(self) -> int:
+        return len(self.members)
+
+    @property
+    def f(self) -> int:
+        return (len(self.members) - 1) // 3
+
+
+class RosterSchedule:
+    """The ordered sequence of roster versions one node knows about.
+
+    Single-threaded (owned by the protocol actor); versions append in
+    order and never retract — a version, once installed, is a durable
+    fact of the log (the RCFG WAL record replays it).
+    """
+
+    def __init__(self, genesis: RosterVersion) -> None:
+        # the base version is 0 for a dealer-provisioned deployment;
+        # a JOINER boots with the cluster's CURRENT version as its
+        # base (its view of history starts at the roster it dials)
+        if genesis.activation_epoch != 0:
+            raise ValueError(
+                "genesis roster must activate at epoch 0"
+            )
+        self._versions: List[RosterVersion] = [genesis]
+
+    def install(self, rv: RosterVersion) -> None:
+        last = self._versions[-1]
+        if rv.version != last.version + 1:
+            raise ValueError(
+                f"roster version {rv.version} does not extend "
+                f"{last.version}"
+            )
+        if rv.activation_epoch <= last.activation_epoch:
+            raise ValueError(
+                f"activation epoch {rv.activation_epoch} does not "
+                f"advance past {last.activation_epoch}"
+            )
+        self._versions.append(rv)
+
+    def version_for(self, epoch: int) -> RosterVersion:
+        """The roster an epoch runs under: the newest version with
+        ``activation_epoch <= epoch`` (epochs below 0 resolve to
+        genesis)."""
+        for rv in reversed(self._versions):
+            if rv.activation_epoch <= epoch:
+                return rv
+        return self._versions[0]
+
+    def latest(self) -> RosterVersion:
+        return self._versions[-1]
+
+    def known_member_ids(self) -> frozenset:
+        """Union of every version's member ids — the membership test
+        for epoch-UNSCOPED traffic (CATCHUP, reshare gossip), where a
+        joiner or a retiree is still a legitimate correspondent."""
+        out: set = set()
+        for rv in self._versions:
+            out.update(rv.member_ids)
+        return frozenset(out)
+
+    def __len__(self) -> int:
+        return len(self._versions)
+
+    def __iter__(self):
+        return iter(self._versions)
 
 
 @guarded_by("_lock", "_members")

@@ -140,6 +140,9 @@ class BatchCrypto:
         self.n = n
         self.f = f
         self.k = k
+        # remembered so per-geometry siblings (the hub's resized-
+        # roster decode groups) inherit the same device-mesh layout
+        self.mesh_shape = None if mesh_shape is None else tuple(mesh_shape)
         self.mesh = None
         if mesh_shape is not None and backend == "tpu":
             from cleisthenes_tpu.parallel.mesh import make_crypto_mesh

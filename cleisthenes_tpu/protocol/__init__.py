@@ -19,6 +19,10 @@ from cleisthenes_tpu.protocol.honeybadger import (
     setup_keys,
 )
 from cleisthenes_tpu.protocol.rbc import RBC
+from cleisthenes_tpu.protocol.reconfig import (
+    ReconfigManager,
+    encode_reconfig_tx,
+)
 from cleisthenes_tpu.protocol.spmd import LockstepCluster
 
 __all__ = [
@@ -32,4 +36,6 @@ __all__ = [
     "LockstepCluster",
     "Behavior",
     "make_behavior",
+    "ReconfigManager",
+    "encode_reconfig_tx",
 ]

@@ -102,6 +102,11 @@ class BehaviorBroadcaster:
         self._members: List[str] = sorted(member_ids)
         self._behavior = behavior
 
+    def set_members(self, member_ids: Sequence[str]) -> None:
+        """Roster-activation support (dynamic membership): the
+        behavior keeps lying to whatever the CURRENT fan-out set is."""
+        self._members = sorted(member_ids)
+
     def broadcast(self, payload) -> None:
         for member in self._members:
             self._send(member, payload)

@@ -97,6 +97,7 @@ class SimulatedCluster:
         group=None,
         member_ids: Optional[Sequence[str]] = None,
         behaviors: Optional[Dict[str, object]] = None,
+        wal_dir: Optional[str] = None,
     ) -> None:
         if config is not None:
             if n != 4 and n != config.n:  # both given and conflicting
@@ -111,6 +112,7 @@ class SimulatedCluster:
         if member_ids is None:
             member_ids = [f"node{i:03d}" for i in range(self.config.n)]
         self.ids: List[str] = sorted(member_ids)
+        self._key_seed = key_seed
         self.keys = setup_keys(self.config, self.ids, seed=key_seed,
                                group=group)
         self.net = ChannelNetwork(
@@ -150,7 +152,28 @@ class SimulatedCluster:
             raise ValueError(f"behaviors for non-members: {unknown}")
         self.behaviors = behaviors
         self.nodes: Dict[str, HoneyBadger] = {}
+        self._hub = hub
+        self._tx_memo = tx_memo
+        self._auto_propose = auto_propose
+        # authenticators are kept per node: dynamic membership
+        # installs joiner pair keys / drops retirees through them
+        self.auths: Dict[str, HmacAuthenticator] = {}
+        # optional per-node durable WALs (crash/restart tests):
+        # wal_dir/<node>.log, restored by restart_node()
+        self._wal_dir = wal_dir
+        # per-node construction parameters, so restart_node() rebuilds
+        # a process-restart-faithful node (same genesis view; the WAL
+        # replay re-derives any roster versions it lived through)
+        self._node_params: Dict[str, dict] = {}
         for nid in self.ids:
+            auth = HmacAuthenticator(nid, self.keys[nid].mac_keys)
+            self.auths[nid] = auth
+            self._node_params[nid] = {
+                "config": self.config,
+                "member_ids": list(self.ids),
+                "joining": False,
+                "roster_version_base": 0,
+            }
             hb = HoneyBadger(
                 config=self.config,
                 node_id=nid,
@@ -161,11 +184,11 @@ class SimulatedCluster:
                 hub=hub,
                 tx_parse_memo=tx_memo,
                 behavior=behaviors.get(nid),
+                authenticator=auth,
+                batch_log=self._make_wal(nid),
             )
             self.nodes[nid] = hb
-            self.net.join(
-                nid, hb, HmacAuthenticator(nid, self.keys[nid].mac_keys)
-            )
+            self.net.join(nid, hb, auth)
             # public route to MAC-rejection/delivery counts:
             # Metrics.snapshot()["transport"]
             hb.metrics.set_transport_stats(
@@ -282,6 +305,261 @@ class SimulatedCluster:
             assert len(lists) == 1, f"fork at epoch {e}"
         return depth
 
+    def _make_wal(self, nid: str):
+        if self._wal_dir is None:
+            return None
+        import os
+
+        from cleisthenes_tpu.core.ledger import BatchLog
+
+        return BatchLog(os.path.join(self._wal_dir, f"{nid}.log"))
+
+    def restart_node(self, nid: str):
+        """Process-restart one (crashed) node from its WAL: a FRESH
+        HoneyBadger rebuilt with the node's ORIGINAL construction
+        parameters replays the log — committed history, ordered-ahead
+        window, and every roster version it lived through (the RCFG
+        records cross-check the re-derivation) — then rejoins the
+        network.  Requires ``wal_dir``."""
+        if self._wal_dir is None:
+            raise ValueError("restart_node() needs wal_dir")
+        old = self.nodes[nid]
+        if old.batch_log is not None:
+            old.batch_log.close()
+        params = self._node_params[nid]
+        auth = HmacAuthenticator(nid, self.keys[nid].mac_keys)
+        self.auths[nid] = auth
+        hb = HoneyBadger(
+            config=params["config"],
+            node_id=nid,
+            member_ids=params["member_ids"],
+            keys=self.keys[nid],
+            out=ChannelBroadcaster(
+                self.net, nid, params["member_ids"]
+            ),
+            auto_propose=self._auto_propose,
+            hub=self._hub,
+            tx_parse_memo=self._tx_memo,
+            authenticator=auth,
+            joining=params["joining"],
+            roster_version_base=params["roster_version_base"],
+            batch_log=self._make_wal(nid),
+        )
+        self.nodes[nid] = hb
+        self.net.restart(nid, hb, auth)
+        hb.metrics.set_transport_stats(
+            lambda nid=nid: self.net.endpoint_stats(nid)
+        )
+        # rewire the observability plane to the NEW instance: the old
+        # watchdog/sampler closures hold the dead node's metrics and
+        # would keep feeding frozen pre-crash state to SLO checks and
+        # scrapes
+        from cleisthenes_tpu.utils.watchdog import SloWatchdog
+
+        wd = SloWatchdog(
+            metrics=hb.metrics,
+            pending_fn=hb.pending_tx_count,
+            stall_factor=self.config.slo_stall_factor,
+            stall_grace_s=self.config.slo_stall_grace_s,
+            queue_depth_limit=self.config.slo_queue_depth,
+            peer_lag_epochs=self.config.slo_peer_lag_epochs,
+            peer_states_fn=lambda nid=nid: self.net.link_states(nid),
+            peer_lag_fn=lambda nid=nid: self._peer_lag(nid),
+            decrypt_lag_budget=self.config.decrypt_lag_max,
+            trace=hb.trace,
+        )
+        hb.metrics.set_alerts(wd.alerts_block)
+        self.watchdogs[nid] = wd
+        old_sampler = self.samplers.pop(nid, None)
+        if old_sampler is not None:
+            from cleisthenes_tpu.transport.obs_http import ObsTarget
+            from cleisthenes_tpu.utils.timeseries import (
+                TimeSeriesSampler,
+            )
+
+            old_sampler.stop()
+            sampler = TimeSeriesSampler(hb.metrics.snapshot)
+            sampler.on_tick(wd.check)
+            sampler.start(self.config.obs_sample_period_s)
+            self.samplers[nid] = sampler
+            if self.obs is not None:
+                fresh = ObsTarget(nid, hb.metrics, wd, sampler)
+                for i, t in enumerate(self.obs.targets):
+                    if t.node_id == nid:
+                        self.obs.targets[i] = fresh
+                        break
+                else:
+                    self.obs.add_target(fresh)
+        return hb
+
+    # -- dynamic membership (protocol.reconfig) ----------------------------
+
+    def roster_versions(self) -> Dict[str, int]:
+        """Every node's ACTIVE roster version (the convergence check
+        reconfig tests assert against)."""
+        return {
+            nid: hb.roster_version for nid, hb in self.nodes.items()
+        }
+
+    def begin_reconfig(
+        self,
+        join: Sequence[str] = (),
+        retire: Sequence[str] = (),
+        submit_via: Optional[str] = None,
+    ) -> int:
+        """Operator surface: construct the joiner nodes, wire them to
+        the network, and submit the RECONFIG transaction that starts
+        the reshare ceremony.  Returns the new version number.  The
+        ceremony itself runs in-band (protocol.reconfig) as the
+        cluster keeps draining epochs; activation follows
+        automatically once the qualified dealer set commits."""
+        from cleisthenes_tpu.protocol import reconfig as rcfg
+
+        # the authoritative current roster is any CURRENT member's
+        # latest version (all agree by construction) — a parked
+        # retiree from an earlier reconfig still sits in self.nodes
+        # but carries no active key material, so it cannot be the
+        # source of the roster's public keys
+        any_node = None
+        for nid in sorted(self.nodes):
+            hb = self.nodes[nid]
+            if hb.active_view.keys is not None and (
+                any_node is None
+                or hb.rosters.latest().version
+                > any_node.rosters.latest().version
+            ):
+                any_node = hb
+        if any_node is None:
+            raise ValueError("no active member to anchor the reconfig")
+        latest = any_node.rosters.latest()
+        current = list(latest.member_ids)
+        version = latest.version + 1
+        unknown = sorted(set(retire) - set(current))
+        if unknown:
+            raise ValueError(f"cannot retire non-members: {unknown}")
+        clash = sorted(set(join) & set(current))
+        if clash:
+            raise ValueError(f"cannot join existing members: {clash}")
+        new_ids = sorted((set(current) - set(retire)) | set(join))
+        old_view_keys = any_node.active_view.keys
+        enroll_pubs: Dict[str, int] = {}
+        for jid in sorted(join):
+            secret, pub = self._add_joiner(
+                jid, version, current, old_view_keys
+            )
+            enroll_pubs[jid] = pub
+        tx = rcfg.encode_reconfig_tx(
+            version,
+            [(mid, "", 0) for mid in new_ids],
+            enroll_pubs,
+            any_node.group,
+        )
+        via = submit_via
+        if via is None:  # first member surviving the change
+            via = next(m for m in current if m not in set(retire))
+        self.nodes[via].add_transaction(tx)
+        return version
+
+    def _add_joiner(
+        self,
+        jid: str,
+        version: int,
+        current_ids: Sequence[str],
+        old_keys,
+    ):
+        """Construct + wire one JOINER: enrollment keypair (seeded
+        off key_seed for replayable tests), bootstrap NodeKeys (public
+        threshold keys + DH-derived pair keys, no shares), and a
+        ``joining=True`` HoneyBadger attached to the live network."""
+        import dataclasses as _dc
+        import hashlib as _hashlib
+
+        from cleisthenes_tpu.protocol import reconfig as rcfg
+        from cleisthenes_tpu.protocol.honeybadger import NodeKeys
+        from cleisthenes_tpu.utils.watchdog import SloWatchdog
+
+        eseed = int.from_bytes(
+            _hashlib.sha256(
+                b"cluster-enroll|%d|%d|" % (self._key_seed, version)
+                + jid.encode("utf-8")
+            ).digest()[:8],
+            "big",
+        )
+        secret, pub = rcfg.enrollment_keypair(
+            eseed, old_keys.tpke_pub.group
+        )
+        mac_keys = rcfg.joiner_bootstrap_keys(
+            secret, version, old_keys.coin_pub, current_ids, jid
+        )
+        keys = NodeKeys(
+            tpke_pub=old_keys.tpke_pub,
+            tpke_share=None,
+            coin_pub=old_keys.coin_pub,
+            coin_share=None,
+            mac_keys=mac_keys,
+            enroll_secret=secret,
+        )
+        jcfg = _dc.replace(self.config, n=len(current_ids), f=None)
+        auth = HmacAuthenticator(jid, mac_keys)
+        self._node_params[jid] = {
+            "config": jcfg,
+            "member_ids": list(current_ids),
+            "joining": True,
+            "roster_version_base": version - 1,
+        }
+        hb = HoneyBadger(
+            config=jcfg,
+            node_id=jid,
+            member_ids=current_ids,
+            keys=keys,
+            out=ChannelBroadcaster(self.net, jid, current_ids),
+            auto_propose=self._auto_propose,
+            hub=self._hub,
+            tx_parse_memo=self._tx_memo,
+            authenticator=auth,
+            joining=True,
+            roster_version_base=version - 1,
+            batch_log=self._make_wal(jid),
+        )
+        self.nodes[jid] = hb
+        self.auths[jid] = auth
+        self.keys[jid] = keys
+        self.net.join(jid, hb, auth)
+        hb.metrics.set_transport_stats(
+            lambda jid=jid: self.net.endpoint_stats(jid)
+        )
+        if jid not in self.ids:
+            self.ids.append(jid)
+            self.ids.sort()
+        wd = SloWatchdog(
+            metrics=hb.metrics,
+            pending_fn=hb.pending_tx_count,
+            stall_factor=self.config.slo_stall_factor,
+            stall_grace_s=self.config.slo_stall_grace_s,
+            queue_depth_limit=self.config.slo_queue_depth,
+            peer_lag_epochs=self.config.slo_peer_lag_epochs,
+            peer_states_fn=lambda jid=jid: self.net.link_states(jid),
+            peer_lag_fn=lambda jid=jid: self._peer_lag(jid),
+            decrypt_lag_budget=self.config.decrypt_lag_max,
+            trace=hb.trace,
+        )
+        hb.metrics.set_alerts(wd.alerts_block)
+        self.watchdogs[jid] = wd
+        if self.obs is not None:
+            from cleisthenes_tpu.transport.obs_http import ObsTarget
+            from cleisthenes_tpu.utils.timeseries import (
+                TimeSeriesSampler,
+            )
+
+            sampler = TimeSeriesSampler(hb.metrics.snapshot)
+            sampler.on_tick(wd.check)
+            sampler.start(self.config.obs_sample_period_s)
+            self.samplers[jid] = sampler
+            self.obs.add_target(
+                ObsTarget(jid, hb.metrics, wd, sampler)
+            )
+        return secret, pub
+
     # -- observability (telemetry + SLO surface) ---------------------------
 
     def _peer_lag(self, node_id: str) -> Dict[str, int]:
@@ -315,6 +593,9 @@ class SimulatedCluster:
             sampler.stop()
         if self.obs is not None:
             self.obs.stop()
+        for hb in self.nodes.values():
+            if hb.batch_log is not None:
+                hb.batch_log.close()
 
     # -- observability (the flight-recorder surface) -----------------------
 

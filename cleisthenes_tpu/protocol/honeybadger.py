@@ -75,6 +75,7 @@ from cleisthenes_tpu.transport.message import (
     Message,
     RbcPayload,
     ReadyBatchPayload,
+    ResharePayload,
 )
 
 # Sliding epoch window: how many settled epochs stay responsive for
@@ -215,13 +216,21 @@ class NodeKeys:
     """Everything one validator needs from the dealer."""
 
     tpke_pub: ThresholdPublicKey
-    tpke_share: ThresholdSecretShare
+    tpke_share: Optional[ThresholdSecretShare]
     coin_pub: ThresholdPublicKey
-    coin_share: ThresholdSecretShare
+    coin_share: Optional[ThresholdSecretShare]
     # this node's pairwise MAC keys: peer_id -> k_{self,peer}.  The
     # dealer's master never leaves setup_keys, so no single member can
     # reconstruct another pair's key (ADVICE.md round-1 high finding).
     mac_keys: Dict[str, bytes]
+    # dynamic membership (protocol.reconfig): a JOINER's static-DH
+    # enrollment secret — its share-blob decryption and MAC-derivation
+    # identity until the reshare ceremony hands it real threshold
+    # shares.  None for dealer-provisioned roster members (their coin
+    # share doubles as the DH identity).  A joiner boots with
+    # tpke_share/coin_share None: it holds no threshold material
+    # before its activation epoch.
+    enroll_secret: Optional[int] = None
 
 
 def setup_keys(
@@ -313,9 +322,55 @@ def _logical_count_many(items) -> int:
     return sum(_logical_count(p) for p in items)
 
 
+class _RosterView:
+    """One roster version's resolved runtime state: the derived
+    Config (n/f/thresholds), the sorted member table, this node's key
+    set and the crypto service objects bound to it.  Every epoch-
+    scoped structure — ACS (and its EchoBank/VoteBank), the demux
+    window, the dec-share pools, the WaveRouter's dispatch targets —
+    resolves n/f/keys through the EPOCH's view instead of the
+    construction-time constants (the dynamic-membership refactor;
+    staticcheck DET005 gates regressions).
+
+    ``keys``/``tpke``/``coin`` are None exactly when ``local`` is
+    False (this node is not a member under the version — a joiner
+    before its activation epoch, or a retiree after): such a node
+    never constructs protocol state for the version's epochs.
+    """
+
+    __slots__ = (
+        "rv",
+        "config",
+        "member_ids",
+        "member_set",
+        "keys",
+        "crypto",
+        "tpke",
+        "coin",
+        "local",
+    )
+
+    def __init__(
+        self, rv, config, member_ids, keys, crypto, tpke, coin
+    ) -> None:
+        self.rv = rv
+        self.config = config
+        self.member_ids: Tuple[str, ...] = tuple(sorted(member_ids))
+        self.member_set = frozenset(self.member_ids)
+        self.keys = keys
+        # the version's OWN BatchCrypto: the erasure coder is sized
+        # (n, k = n - 2f) per roster, so RBC under a resized roster
+        # encodes/decodes with the right geometry
+        self.crypto = crypto
+        self.tpke = tpke
+        self.coin = coin
+        self.local = keys is not None
+
+
 class _EpochState:
     __slots__ = (
         "acs",
+        "view",
         "proposed",
         "my_txs",
         "output",
@@ -330,12 +385,18 @@ class _EpochState:
         "t_ordered",
     )
 
-    def __init__(self, acs: Optional[ACS]) -> None:
+    def __init__(
+        self, acs: Optional[ACS], view: Optional[_RosterView] = None
+    ) -> None:
         # ``acs`` is None for SETTLE-ONLY states (two-frontier mode):
         # epochs whose ordering is already durable — WAL replay after a
         # crash between COrd and CLOG, or COrd catch-up adoption — that
         # only need the trailing decryption, never a consensus re-run.
         self.acs = acs
+        # the roster version this epoch runs under (set by every
+        # construction site; epoch-scoped membership/threshold/key
+        # reads resolve through it)
+        self.view = view
         self.proposed = False
         self.my_txs: List[bytes] = []
         self.output: Optional[Dict[str, bytes]] = None
@@ -404,6 +465,9 @@ class HoneyBadger:
         hub=None,
         tx_parse_memo: Optional[_Memo] = None,
         behavior=None,
+        authenticator=None,
+        joining: bool = False,
+        roster_version_base: int = 0,
     ) -> None:
         self.config = config
         # cluster simulations pass one shared make_tx_parse_memo()
@@ -412,10 +476,18 @@ class HoneyBadger:
         self.node_id = node_id
         self.members: List[str] = sorted(member_ids)
         self._member_set = frozenset(self.members)
-        if node_id not in self.members:
+        if node_id not in self.members and not joining:
+            # ``joining=True`` is the dynamic-membership bootstrap: a
+            # JOINER constructs against the current roster it is NOT a
+            # member of, adopts the log via CATCHUP, and participates
+            # from the activation epoch the reshare ceremony fixes
             raise ValueError(f"{node_id!r} not in roster")
         self.keys = keys
         self.auto_propose = auto_propose
+        # the node's envelope-MAC authenticator (optional): dynamic
+        # membership installs joiner pair keys / drops retired ones
+        # through it; None keeps the historical fixed-roster behavior
+        self._authenticator = authenticator
 
         self.crypto: BatchCrypto = get_backend(config)
         self.tpke = self.crypto.tpke(keys.tpke_pub)
@@ -520,50 +592,56 @@ class HoneyBadger:
         # (bounded: one entry per remembered epoch)
         self._committed_filter: Set[bytes] = set()
         self._committed_history: List[Set[bytes]] = []
-        # durable committed-batch log (core.ledger.BatchLog): restore
-        # the committed history + epoch counter + dup-filter on restart
-        self.batch_log = batch_log
-        if batch_log is not None and self.trace is not None:
-            batch_log.trace = self.trace  # WAL appends on our timeline
-        self._commits_since_ckpt = 0
-        if batch_log is not None and batch_log.last_epoch is not None:
-            # seed the dup-filter from the last checkpoint (if any) and
-            # fold only the batches logged after it; the full batch
-            # history is still replayed for catch-up serving
-            ckpt_epoch = -1
-            ckpt = batch_log.last_checkpoint
-            if ckpt is not None:
-                ckpt_epoch, history = ckpt
-                for seen in history:
-                    self._remember_committed(set(seen))
-            for epoch, batch in batch_log.replay():
-                self.committed_batches.append(batch)
-                if epoch > ckpt_epoch:
-                    self._remember_committed(set(batch.tx_list()))
-            self.epoch = batch_log.last_epoch + 1
-        if (
-            self._two_frontier
-            and batch_log is not None
-            and batch_log.last_ordered_epoch is not None
-        ):
-            # ordered-ahead epochs (COrd records with no CLOG yet — a
-            # crash landed between order and settle): re-enter them
-            # into the settler as settle-only states.  The ordering is
-            # NEVER re-run; the plaintext arrives via the re-issued
-            # dec-share exchange (every restarted node re-broadcasts
-            # its own shares from the settler) and/or CLOG catch-up
-            # from peers that already settled.
-            for oepoch, body in batch_log.replay_ordered():
-                if oepoch < self.epoch:
-                    continue  # its CLOG follows in the log: settled
-                _e, output = decode_ordered_body(body)
-                es = _EpochState(None)
-                es.proposed = True
-                es.output = output
-                es.ordered = True
-                self._epochs[oepoch] = es
-                self._ordered_bodies[oepoch] = body
-                self.epoch = oepoch + 1
+        # -- dynamic membership (protocol.reconfig) ----------------------
+        # Versioned rosters: v0 is the construction-time roster; every
+        # later version installs from a committed RECONFIG ceremony.
+        # Epoch-scoped state resolves through roster_for(epoch); the
+        # self.members/self.keys/self.tpke/self.coin fields above track
+        # the ACTIVE version (swapped at the activation boundary).
+        from cleisthenes_tpu.core.member import (
+            Member as _Member,
+            RosterSchedule,
+            RosterVersion,
+        )
+        from cleisthenes_tpu.protocol.reconfig import ReconfigManager
+
+        genesis = RosterVersion(
+            # a joiner's base version is the cluster's CURRENT one:
+            # the next RECONFIG it discovers must extend it
+            version=roster_version_base,
+            activation_epoch=0,
+            members=tuple(_Member(id=m) for m in self.members),
+        )
+        self.rosters = RosterSchedule(genesis)
+        v0_local = node_id in self._member_set
+        self._views: Dict[int, _RosterView] = {
+            genesis.version: _RosterView(
+                genesis,
+                config,
+                self.members,
+                keys if v0_local else None,
+                self.crypto,
+                self.tpke if v0_local else None,
+                self.coin if v0_local else None,
+            )
+        }
+        self._active_version = genesis.version
+        # set True when this node's id leaves the active roster: it
+        # orders its last epoch at the boundary and parks (serving
+        # CATCHUP until peers tear it down)
+        self._retired_self = False
+        # (activation_epoch, retired_ids, new_view): armed at version
+        # install, fired when the SETTLED frontier crosses the
+        # boundary — retired pair keys drop, broadcast set narrows,
+        # transports tear down dial state (on_peer_retired)
+        self._pending_teardown: Optional[tuple] = None
+        # transport hooks (set by ValidatorHost / harnesses): called
+        # at reconfig discovery with a joiner's (id, "ip:port") so the
+        # dial layer opens a lane, and at teardown with a retiree's id
+        self.on_peer_added: Optional[Callable[[str, str], None]] = None
+        self.on_peer_retired: Optional[Callable[[str], None]] = None
+        self._reconfig = ReconfigManager(self)
+        self.metrics.set_reconfig(lambda: self._active_version)
         # CATCHUP: epoch -> sender -> response body.  Epochs adopt in
         # order at the commit frontier, each on f+1 identical bodies
         # (>= 1 honest sender => the true committed batch).
@@ -589,6 +667,73 @@ class HoneyBadger:
         # push a quiescent cluster wedges.  ``limit`` is fixed at
         # serve time, so one request never buys an unbounded stream.
         self._catchup_plain_owed: Dict[str, Tuple[int, int]] = {}
+        # sender -> from_epoch of a request we could serve NOTHING for
+        # (it asked at our own frontier): re-served when settlement
+        # advances past it.  Without the park, a requester exactly one
+        # epoch behind at quiescence wedges — its per-frontier dedup
+        # never re-asks and no traffic renudges it (the dynamic-
+        # membership joiner chasing the activation boundary hits this
+        # on its final window).  One entry per sender, one window per
+        # settlement advance: no amplification beyond a normal serve.
+        self._catchup_parked: Dict[str, int] = {}
+        # durable committed-batch log (core.ledger.BatchLog): restore
+        # the committed history + epoch counter + dup-filter on restart
+        self.batch_log = batch_log
+        if batch_log is not None and self.trace is not None:
+            batch_log.trace = self.trace  # WAL appends on our timeline
+        self._commits_since_ckpt = 0
+        if batch_log is not None and batch_log.last_epoch is not None:
+            # seed the dup-filter from the last checkpoint (if any) and
+            # fold only the batches logged after it; the full batch
+            # history is still replayed for catch-up serving
+            self._reconfig.replaying = True
+            ckpt_epoch = -1
+            ckpt = batch_log.last_checkpoint
+            if ckpt is not None:
+                ckpt_epoch, history = ckpt
+                for seen in history:
+                    self._remember_committed(set(seen))
+            for epoch, batch in batch_log.replay():
+                self.committed_batches.append(batch)
+                if epoch > ckpt_epoch:
+                    self._remember_committed(set(batch.tx_list()))
+                # re-derive the reconfig plane (RECONFIG + dealing txs
+                # are ordinary committed txs): roster versions, key
+                # material and activation boundaries replay
+                # deterministically from the batch content alone
+                self._reconfig.on_batch_settled(epoch, batch)
+            self.epoch = batch_log.last_epoch + 1
+        if (
+            self._two_frontier
+            and batch_log is not None
+            and batch_log.last_ordered_epoch is not None
+        ):
+            # ordered-ahead epochs (COrd records with no CLOG yet — a
+            # crash landed between order and settle): re-enter them
+            # into the settler as settle-only states.  The ordering is
+            # NEVER re-run; the plaintext arrives via the re-issued
+            # dec-share exchange (every restarted node re-broadcasts
+            # its own shares from the settler) and/or CLOG catch-up
+            # from peers that already settled.
+            for oepoch, body in batch_log.replay_ordered():
+                if oepoch < self.epoch:
+                    continue  # its CLOG follows in the log: settled
+                _e, output = decode_ordered_body(body)
+                es = _EpochState(None, self.roster_for(oepoch))
+                es.proposed = True
+                es.output = output
+                es.ordered = True
+                self._epochs[oepoch] = es
+                self._ordered_bodies[oepoch] = body
+                self.epoch = oepoch + 1
+        if batch_log is not None:
+            # leave replay mode: cross-check the re-derived roster
+            # schedule against the WAL's RCFG records, re-deal if a
+            # ceremony is still pending, and fast-forward the ACTIVE
+            # roster to whatever version self.epoch runs under
+            self._reconfig.after_replay()
+            self._maybe_activate_roster()
+            self._maybe_teardown_retired()
 
     def _remember_committed(self, seen: Set[bytes]) -> None:
         """Fold one epoch's committed txs into the bounded duplicate
@@ -625,13 +770,17 @@ class HoneyBadger:
                 tr.instant("epoch", "open", epoch=target)
             t0 = 0.0 if tr is None else tr.now()
             es.my_txs = self._create_batch()
-            ct = self.tpke.encrypt(serialize_txs(es.my_txs))
+            # the EPOCH's key set (an epoch past an activation
+            # boundary encrypts under the reshared key even while the
+            # proposer's active roster is still the old one)
+            view = es.view
+            ct = view.tpke.encrypt(serialize_txs(es.my_txs))
             if tr is not None:
                 tr.complete(
                     "tpke", "encrypt", t0, epoch=target, txs=len(es.my_txs)
                 )
             es.acs.input(
-                serialize_ciphertext(ct, self.keys.tpke_pub.group)
+                serialize_ciphertext(ct, view.keys.tpke_pub.group)
             )
         finally:
             self._exit_turn()
@@ -668,11 +817,254 @@ class HoneyBadger:
         compares and ordered CATCHUP serves."""
         return self._ordered_bodies.get(epoch)
 
+    # -- dynamic membership (protocol.reconfig) ----------------------------
+
+    @property
+    def group(self):
+        """The crypto group every roster version of this deployment
+        shares (the modulus seam: reconfig ceremonies deal over the
+        same group the genesis keys use)."""
+        return self.keys.tpke_pub.group
+
+    @property
+    def active_view(self) -> _RosterView:
+        """The ACTIVE roster version's resolved view (the one
+        ``self.epoch`` runs under after every boundary crossing)."""
+        return self._views[self._active_version]
+
+    @property
+    def roster_version(self) -> int:
+        return self._active_version
+
+    def roster_for(self, epoch: int) -> _RosterView:
+        """Resolve the roster version an epoch runs under — THE
+        accessor every epoch-scoped n/f/key read goes through
+        (staticcheck DET005 gates direct construction-time reads)."""
+        return self._views[self.rosters.version_for(epoch).version]
+
+    def on_reconfig_discovered(self, spec, joiners) -> None:
+        """A RECONFIG transaction settled: install the transition's
+        pair keys, widen the broadcast set to old ∪ new (pre-
+        activation epochs still need the retirees; ceremony traffic
+        and post-activation epochs need the joiners), and open dial/
+        serving lanes toward the joiners."""
+        pair_keys = self._reconfig.joiner_pair_keys(spec)
+        if self._authenticator is not None:
+            for peer in sorted(pair_keys):
+                self._authenticator.set_peer_key(peer, pair_keys[peer])
+        old_ids = set(self.active_view.member_ids)
+        if self.node_id not in old_ids:
+            return  # a joiner widens nothing: it adopts, then activates
+        union = sorted(old_ids | set(spec.member_ids))
+        self._set_broadcast_members(union)
+        addr_of = {m[0]: (m[1], m[2]) for m in spec.members}
+        for j in joiners:
+            # a joiner's very first CATCHUP request may predate our
+            # knowledge of it (MAC-rejected): remember a standing
+            # from-0 request so the serving side initiates
+            self._catchup_last_req.setdefault(j, 0)
+            if self.on_peer_added is not None:
+                # async transports (gRPC): the dial layer opens the
+                # lane and fires peer_reconnected on success, which
+                # serves the standing request
+                ip, port = addr_of[j]
+                self.on_peer_added(j, f"{ip}:{port}")
+            elif not self._reconfig.replaying:
+                # in-proc transports deliver immediately: serve the
+                # joiner's bootstrap window now
+                self._handle_catchup_req(
+                    j, CatchupReqPayload(from_epoch=0)
+                )
+
+    def install_roster_version(self, rv, keys, spec) -> None:
+        """A reshare ceremony finalized: bind the version's runtime
+        view, arm the retirement teardown, and write the RCFG WAL
+        record — all strictly before any epoch orders under it."""
+        import dataclasses as _dc
+
+        cfg = _dc.replace(self.config, n=rv.n, f=None)
+        local = self.node_id in rv.member_ids
+        if local and keys is None:
+            raise ValueError("member view installed without keys")
+        crypto = (
+            self.crypto
+            if cfg.n == self.config.n and cfg.f == self.config.f
+            else get_backend(cfg)
+        )
+        view = _RosterView(
+            rv,
+            cfg,
+            rv.member_ids,
+            keys,
+            crypto,
+            crypto.tpke(keys.tpke_pub) if local else None,
+            crypto.coin(keys.coin_pub) if local else None,
+        )
+        self._views[rv.version] = view
+        self.rosters.install(rv)
+        prev = self.rosters.version_for(rv.activation_epoch - 1)
+        retired = sorted(
+            set(prev.member_ids) - set(rv.member_ids)
+        )
+        self._pending_teardown = (rv.activation_epoch, retired, view)
+        if self.trace is not None:
+            self.trace.instant(
+                "reconfig",
+                "install",
+                version=rv.version,
+                activation_epoch=rv.activation_epoch,
+            )
+        self.log.info(
+            "roster version installed",
+            version=rv.version,
+            activation_epoch=rv.activation_epoch,
+            n=rv.n,
+        )
+        if (
+            self.batch_log is not None
+            and not self._reconfig.replaying
+        ):
+            self.batch_log.append_reconfig(
+                rv.version,
+                rv.activation_epoch,
+                [(m.id, m.addr.ip, m.addr.port) for m in rv.members],
+                rv.key_material_digest,
+            )
+        # a laggard can have built epoch states PAST the boundary
+        # under the old roster before learning of the ceremony (it
+        # ordered ahead of its settled frontier): those states are
+        # wrong-view by construction and can never complete — drop
+        # them; the epochs re-enter via live traffic or CATCHUP
+        for e in sorted(self._epochs):
+            if (
+                e >= rv.activation_epoch
+                and self._epochs[e].view is not view
+            ):
+                del self._epochs[e]
+                self.hub.drop_scope((self.node_id, e))
+        # the boundary only activates when the frontier reaches it:
+        # if the cluster is otherwise quiescent, kick the epoch drive
+        # now (the _advance_epoch condition keeps it rolling to the
+        # switch) instead of wedging mid-transition until the next
+        # client transaction
+        if (
+            self.auto_propose
+            and not self._reconfig.replaying
+            and not self._retired_self
+            and self.epoch < rv.activation_epoch
+        ):
+            self.start_epoch()
+
+    def _maybe_activate_roster(self) -> None:
+        """Cross the activation boundary when the live frontier
+        reaches it: swap the ACTIVE view (keys, batch policy, metrics
+        identity).  Runs at every epoch advance; a restart replaying
+        far past a boundary crosses every intermediate version in
+        order."""
+        while True:
+            rv = self.rosters.version_for(self.epoch)
+            if rv.version == self._active_version:
+                return
+            nxt = None
+            for candidate in self.rosters:
+                if candidate.version == self._active_version + 1:
+                    nxt = candidate
+                    break
+            view = self._views[nxt.version]
+            self._active_version = nxt.version
+            self.metrics.reconfigs_total.inc()
+            if self.trace is not None:
+                self.trace.instant(
+                    "reconfig",
+                    "activate",
+                    version=nxt.version,
+                    epoch=self.epoch,
+                )
+            if not view.local:
+                # retired: order nothing further; keep serving
+                # CATCHUP and settling the pre-boundary epochs
+                self._retired_self = True
+                self.log.info(
+                    "retired from roster", version=nxt.version
+                )
+                continue
+            self._retired_self = False
+            prev_members = self.members
+            self.members = list(view.member_ids)
+            self._member_set = view.member_set
+            self.keys = view.keys
+            self.tpke = view.tpke
+            self.coin = view.coin
+            self.b = max(self.config.batch_size, view.config.n)
+            # fan out to old ∪ new until the settled frontier crosses
+            # the boundary (teardown narrows to the new roster): the
+            # outgoing roster still needs our dec shares for pre-
+            # boundary epochs, and — the JOINER's case — our own
+            # post-boundary votes must reach ourselves and any
+            # co-joiner from the very first new-roster epoch, not
+            # only once settlement catches up.  If this boundary's
+            # teardown ALREADY fired (a catch-up adopter can settle
+            # past the boundary before its ordered frontier crosses
+            # it), the retirees' pair keys are gone — never re-widen
+            # to peers we can no longer sign for.
+            pt = self._pending_teardown
+            if pt is not None and pt[2] is view:
+                fanout = set(prev_members) | set(view.member_ids)
+            else:
+                fanout = set(view.member_ids)
+            self._set_broadcast_members(sorted(fanout))
+            self.log.info(
+                "roster activated",
+                version=nxt.version,
+                n=view.config.n,
+            )
+
+    def _maybe_teardown_retired(self) -> None:
+        """The settled frontier crossed an activation boundary: every
+        pre-boundary epoch is plaintext-durable, so the retirees'
+        duties are over — narrow the broadcast set to the new roster,
+        drop their pair keys, and tear down their transport lanes."""
+        pt = self._pending_teardown
+        if pt is None:
+            return
+        activation, retired, view = pt
+        if len(self.committed_batches) < activation:
+            return
+        self._pending_teardown = None
+        if not view.local:
+            return  # the retiree keeps its lanes for CATCHUP serving
+        self._set_broadcast_members(view.member_ids)
+        for peer in retired:
+            if self._authenticator is not None:
+                self._authenticator.drop_peer(peer)
+            if self.on_peer_retired is not None:
+                self.on_peer_retired(peer)
+        if retired and self.trace is not None:
+            self.trace.instant(
+                "reconfig",
+                "teardown",
+                version=view.rv.version,
+                retired=len(retired),
+            )
+
+    def _set_broadcast_members(self, member_ids) -> None:
+        """Swap the outbound fan-out set (coalescer + inner
+        broadcaster + the semantic-adversary wrapper when mounted)."""
+        ids = sorted(member_ids)
+        self._coalesce.set_members(ids)
+        behavior_out = getattr(self.out, "_inner", None)
+        set_members = getattr(behavior_out, "set_members", None)
+        if set_members is not None and behavior_out is not self._coalesce:
+            set_members(ids)
+        self.out._n = len(ids)
+
     # -- batch policy (reference honeybadger.go:62-104) --------------------
 
     def _create_batch(self) -> List[bytes]:
         candidates = self._load_candidate_txs(min(self.b, len(self.que)))
-        return self._select_random_txs(candidates, self.b // self.config.n)
+        # the ACTIVE roster's width (b/n sampling follows the live n)
+        n = self.active_view.config.n
+        return self._select_random_txs(candidates, self.b // n)
 
     def _load_candidate_txs(self, count: int) -> List[bytes]:
         """Poll up to ``count`` txs off the queue head
@@ -769,9 +1161,11 @@ class HoneyBadger:
         tr = self.trace
         t0 = 0.0 if tr is None else tr.now()
         self._pending_coin_issues = []
-        group = self.keys.coin_pub.group
-        vks = self.keys.coin_pub.verification_keys
-        sec = self.keys.coin_share
+        # per-instance key material: a wave can span an activation
+        # boundary (dynamic membership), so each BBA issues under ITS
+        # epoch's coin key/share — the group is deployment-wide, so
+        # the whole mixed wave still batches into one dispatch
+        group = self.group
         items = []
         metas = []
         for bba, rnd in pend:
@@ -779,8 +1173,12 @@ class HoneyBadger:
             # the aux quorum fired, and withholding the (public,
             # deterministic) share after a TERM decision can leave
             # slower peers one share short of the coin threshold
-            _pub, base, context = bba.coin.group_params(bba._coin_id(rnd))
-            items.append((sec, base, context, vks[sec.index - 1]))
+            pub, base, context = bba.coin.group_params(bba._coin_id(rnd))
+            sec = bba.coin_secret
+            items.append(
+                (sec, base, context,
+                 pub.verification_keys[sec.index - 1])
+            )
             metas.append((bba, rnd))
         if not items:
             return
@@ -842,6 +1240,13 @@ class HoneyBadger:
         if pcls is CatchupOrdPayload:
             self._handle_catchup_ord(sender_id, payload)
             return
+        if pcls is ResharePayload:
+            # reconfig gossip (epoch-unscoped like CATCHUP): staged
+            # by the reshare plane; for a joiner it doubles as the
+            # "a ceremony is underway, chase the log" nudge
+            if self._reconfig.known_member(sender_id):
+                self._reconfig.on_reshare_payload(sender_id, payload)
+            return
         epoch = getattr(payload, "epoch", None)
         if epoch is None:
             return
@@ -850,9 +1255,19 @@ class HoneyBadger:
         # that _epoch_state re-derives for every one of the O(N^2)
         # payloads per wave
         es = self._epochs.get(epoch) or self._epoch_state(epoch)
-        if es is None:  # outside the sliding window
+        if es is None:  # outside the sliding window, or not a member
             if epoch > self.epoch + EPOCH_HORIZON:
                 # peers are far ahead: we missed epochs, catch up
+                self._note_farahead()
+            elif (
+                epoch > self.epoch
+                and not self.roster_for(epoch).local
+            ):
+                # traffic for an epoch we cannot participate in
+                # (dynamic membership: a joiner watching the old
+                # roster run ahead of its adopted frontier): every
+                # sighting ticks the same traffic-clocked catch-up
+                # chase the far-ahead path uses
                 self._note_farahead()
             return
         cls = pcls
@@ -915,14 +1330,23 @@ class HoneyBadger:
             return None
         es = self._epochs.get(epoch)
         if es is None:
+            # every epoch-scoped structure — the ACS and its
+            # EchoBank/VoteBank, the coin, the dec-share pools —
+            # resolves n/f/keys through the EPOCH's roster version
+            view = self.roster_for(epoch)
+            if not view.local:
+                # not a member under this epoch's roster: a joiner
+                # before activation (adopts via CATCHUP), or a
+                # retiree after (parks) — no protocol state exists
+                return None
             acs = ACS(
-                config=self.config,
-                crypto=self.crypto,
+                config=view.config,
+                crypto=view.crypto,
                 epoch=epoch,
                 owner=self.node_id,
-                member_ids=self.members,
-                coin=self.coin,
-                coin_secret=self.keys.coin_share,
+                member_ids=view.member_ids,
+                coin=view.coin,
+                coin_secret=view.keys.coin_share,
                 out=self.out,
                 hub=self.hub,
                 coin_issue_sink=self._queue_coin_issue,
@@ -930,7 +1354,7 @@ class HoneyBadger:
                 metrics=self.metrics,
             )
             acs.on_output = self._on_acs_output
-            es = _EpochState(acs)
+            es = _EpochState(acs, view)
             self._epochs[epoch] = es
         return es
 
@@ -982,6 +1406,10 @@ class HoneyBadger:
         if es.shares_issued or es.output is None:
             return
         es.shares_issued = True
+        view = es.view
+        local_share = (
+            view.local and view.keys.tpke_share is not None
+        )
         tr = self.trace
         t_share0 = 0.0 if tr is None else tr.now()
         issue_cts = []
@@ -991,7 +1419,9 @@ class HoneyBadger:
                 continue
             try:
                 ct = deserialize_ciphertext(
-                    ct_bytes, self.keys.tpke_pub.group
+                    ct_bytes, view.keys.tpke_pub.group
+                    if local_share
+                    else self.group
                 )
             except ValueError:
                 # Byzantine proposer RBC'd junk: every correct node
@@ -1001,8 +1431,14 @@ class HoneyBadger:
             es.ciphertexts[proposer] = ct
             issue_cts.append(ct)
             issue_proposers.append(proposer)
-        dec_shares = self.tpke.dec_share_batch(
-            self.keys.tpke_share, issue_cts
+        if not local_share:
+            # no threshold share under this epoch's roster (a joiner
+            # bootstrapping, or an adopted ordering from before our
+            # membership): the plaintext arrives via peers' shares or
+            # CLOG catch-up — nothing to issue
+            return
+        dec_shares = view.tpke.dec_share_batch(
+            view.keys.tpke_share, issue_cts
         )
         for proposer, share in zip(issue_proposers, dec_shares):
             self.out.broadcast(
@@ -1151,14 +1587,17 @@ class HoneyBadger:
         e: int,
         z: int,
     ) -> None:
+        view = es.view
+        if not view.local:
+            return  # no threshold material: the epoch settles via CLOG
         if (
-            sender not in self._member_set
-            or proposer not in self._member_set  # bounds es.dec_shares
-            or not (1 <= index <= self.config.n)
+            sender not in view.member_set
+            or proposer not in view.member_set  # bounds es.dec_shares
+            or not (1 <= index <= view.config.n)
         ):
             return
         pool = es.dec_shares.setdefault(
-            proposer, SharePool(self.keys.tpke_pub.threshold)
+            proposer, SharePool(view.keys.tpke_pub.threshold)
         )
         if not pool.add_lazy(sender, index, d, e, z):
             self.metrics.dedup_absorbed.inc()
@@ -1197,10 +1636,13 @@ class HoneyBadger:
         distinct Shamir index); missed-window cases re-probe via
         _on_acs_output (output arrives after crossing) and
         _on_dec_verdicts (burn with replacements parked)."""
-        member = self._member_set
+        view = es.view
+        if not view.local:
+            return  # no threshold material: the epoch settles via CLOG
+        member = view.member_set
         pools = es.dec_shares
-        threshold = self.keys.tpke_pub.threshold
-        n = self.config.n
+        threshold = view.keys.tpke_pub.threshold
+        n = view.config.n
         opt_failed = es.opt_failed
         opt_short = es.opt_short
         probe = not self._two_frontier  # two-frontier: settler probes
@@ -1263,8 +1705,9 @@ class HoneyBadger:
         ct = es.ciphertexts.get(proposer)
         if ct is None:
             return
+        view = es.view
         pool = es.dec_shares.get(proposer)
-        if pool is None or len(pool) < self.keys.tpke_pub.threshold:
+        if pool is None or len(pool) < view.keys.tpke_pub.threshold:
             return
         if proposer not in es.opt_failed:
             subset = pool.optimistic_subset()
@@ -1277,7 +1720,7 @@ class HoneyBadger:
             tr = self.trace
             t0 = 0.0 if tr is None else tr.now()
             try:
-                plain = self.tpke.combine(ct, subset)
+                plain = view.tpke.combine(ct, subset)
             except ValueError:  # bad tag: an invalid share slipped in
                 es.opt_failed.add(proposer)
                 self.hub.mark_dirty(self)
@@ -1308,8 +1751,9 @@ class HoneyBadger:
 
     def drain_pending(self, wave) -> None:
         for epoch, es in self._epochs.items():
-            if es.output is None or es.committed:
+            if es.output is None or es.committed or not es.view.local:
                 continue
+            view = es.view
             for proposer, ct in es.ciphertexts.items():
                 if proposer in es.decrypted:
                     continue
@@ -1324,9 +1768,9 @@ class HoneyBadger:
                 if not senders:
                     continue
                 wave.add_share(
-                    self.keys.tpke_pub,
+                    view.keys.tpke_pub,
                     ct.c1,
-                    self.tpke.context(ct),
+                    view.tpke.context(ct),
                     senders,
                     shs,
                     lambda snd, ok, pool=pool: self._on_dec_verdicts(
@@ -1344,7 +1788,7 @@ class HoneyBadger:
 
     def after_crypto_flush(self) -> None:
         for epoch, es in list(self._epochs.items()):
-            if es.output is None or es.committed:
+            if es.output is None or es.committed or not es.view.local:
                 continue
             for proposer, ct in list(es.ciphertexts.items()):
                 if proposer in es.decrypted:
@@ -1356,7 +1800,7 @@ class HoneyBadger:
                 if valid is None:
                     continue
                 try:
-                    plain = self.tpke.combine(ct, valid)
+                    plain = es.view.tpke.combine(ct, valid)
                     es.decrypted[proposer] = deserialize_txs(
                         plain, self._tx_parse_memo
                     )
@@ -1397,7 +1841,10 @@ class HoneyBadger:
     def _handle_catchup_req(
         self, sender: str, p: CatchupReqPayload
     ) -> None:
-        if sender not in self._member_set:
+        # membership over time: any known roster version's member —
+        # a bootstrapping joiner or a not-yet-torn-down retiree is a
+        # legitimate catch-up correspondent during the transition
+        if not self._reconfig.known_member(sender):
             return
         start = p.from_epoch
         # remembered even when unservable: if the link to the sender
@@ -1420,7 +1867,12 @@ class HoneyBadger:
             if e in self._ordered_bodies
         ]
         if not (0 <= start < end) and not serve_ord:
+            if 0 <= start and start >= len(self.committed_batches):
+                # asked at (or past) our own frontier: park it and
+                # re-serve when settlement advances past the ask
+                self._catchup_parked[sender] = start
             return  # nothing committed there (yet) that we can serve
+        self._catchup_parked.pop(sender, None)
         end = max(end, start)  # plaintext range may be empty
         # amplification guard: a legitimately catching-up node's
         # from_epoch strictly advances past each window we served it;
@@ -1477,6 +1929,15 @@ class HoneyBadger:
         decrypt-lag bound.  Bounded by the limit fixed at serve time:
         each request buys at most its own window, once as COrd and
         once as CLOG."""
+        if self._catchup_parked:
+            settled = len(self.committed_batches)
+            for sender, start in sorted(self._catchup_parked.items()):
+                if start < settled:
+                    # re-enter the normal serve path (it pops the
+                    # park on success and applies every guard)
+                    self._handle_catchup_req(
+                        sender, CatchupReqPayload(from_epoch=start)
+                    )
         if not self._catchup_plain_owed:
             return
         settled = len(self.committed_batches)
@@ -1529,7 +1990,7 @@ class HoneyBadger:
         crash/rejoin flow); event-driven, so deterministic transports
         stay deterministic."""
         try:
-            if member_id not in self._member_set:
+            if not self._reconfig.known_member(member_id):
                 return
             self._catchup_repeats.pop(member_id, None)
             last = self._catchup_last_req.get(member_id)
@@ -1557,7 +2018,9 @@ class HoneyBadger:
             for body in tally.values():
                 counts[body] = counts.get(body, 0) + 1
             body, votes = max(counts.items(), key=lambda kv: kv[1])
-            if votes < self.config.f + 1:
+            # the quorum width follows the EPOCH's roster (an adopted
+            # epoch past an activation boundary counts under f')
+            if votes < self.roster_for(expected_epoch).config.f + 1:
                 return None
             try:
                 epoch, decoded = decode(body)
@@ -1573,7 +2036,7 @@ class HoneyBadger:
     def _handle_catchup_resp(
         self, sender: str, p: CatchupRespPayload
     ) -> None:
-        if sender not in self._member_set:
+        if not self._reconfig.known_member(sender):
             return
         # plaintext adoption happens at the SETTLED frontier (== the
         # live frontier on the coupled path); in two-frontier mode an
@@ -1626,6 +2089,11 @@ class HoneyBadger:
         self._epochs.pop(epoch, None)  # any partial local state is moot
         self.hub.drop_scope((self.node_id, epoch))
         self._catchup_tallies.pop(epoch, None)
+        # adopted batches feed the reconfig plane exactly like local
+        # settlements: a crashed/partitioned node learns a ceremony
+        # happened from the log it catches up on
+        self._reconfig.on_batch_settled(epoch, batch)
+        self._maybe_teardown_retired()
         self._serve_owed_plaintext()
         if self.on_commit is not None:
             self.on_commit(epoch, batch)
@@ -1649,7 +2117,9 @@ class HoneyBadger:
     def _handle_catchup_ord(
         self, sender: str, p: CatchupOrdPayload
     ) -> None:
-        if sender not in self._member_set or not self._two_frontier:
+        if not self._two_frontier or not self._reconfig.known_member(
+            sender
+        ):
             return
         if not (self.epoch <= p.epoch < self.epoch + CATCHUP_WINDOW):
             return  # stale, or absurdly far ahead: bound tally memory
@@ -1703,7 +2173,7 @@ class HoneyBadger:
             self.trace.instant("catchup", "adopt_ordered", epoch=epoch)
         es = self._epochs.get(epoch)
         if es is None:
-            es = _EpochState(None)
+            es = _EpochState(None, self.roster_for(epoch))
             es.proposed = True
             self._epochs[epoch] = es
         if es.output is None:
@@ -1788,6 +2258,12 @@ class HoneyBadger:
         self._remember_committed(seen)
         if self.batch_log is not None:
             self._maybe_log_checkpoint(epoch)
+        # the reconfig plane reads every settled batch (RECONFIG +
+        # dealing transactions drive discovery / qualified-set /
+        # finalize), and settlement crossing an activation boundary
+        # releases the retirees
+        self._reconfig.on_batch_settled(epoch, batch)
+        self._maybe_teardown_retired()
         if self.on_commit is not None:
             self.on_commit(epoch, batch)
         self._serve_owed_plaintext()
@@ -1817,6 +2293,10 @@ class HoneyBadger:
         commit on the coupled path, at every ORDERING in two-frontier
         mode (where commit = settle trails behind)."""
         self.epoch += 1
+        # crossing a roster activation boundary swaps the ACTIVE view
+        # (keys, batch policy) before anything proposes into the new
+        # epoch
+        self._maybe_activate_roster()
         settled = len(self.committed_batches)
         for stale in [  # tallies below the frontier can never adopt
             e for e in self._catchup_tallies if e < settled
@@ -1841,10 +2321,16 @@ class HoneyBadger:
         self._catchup_repeats.clear()
         self._farahead_sightings = 0
         self._prune_epoch_states()
-        # propose into the new epoch if we have work, or if peers
-        # already started it (its state exists from buffered traffic)
+        # propose into the new epoch if we have work, if peers already
+        # started it (its state exists from buffered traffic), or if
+        # an installed roster switch still lies ahead — the boundary
+        # only activates when the frontier REACHES it, so the old
+        # roster drives (possibly empty) epochs up to the switch
+        # instead of letting a quiescent cluster wedge mid-transition
         if self.auto_propose and (
-            len(self.que) > 0 or self.epoch in self._epochs
+            len(self.que) > 0
+            or self.epoch in self._epochs
+            or self.epoch < self.rosters.latest().activation_epoch
         ):
             self.start_epoch()
         if self._two_frontier:

@@ -138,7 +138,7 @@ class HubWave:
         self.b_slots: List[Tuple] = []  # unique (root, leaf, branch, idx)
         self.b_items: List[Tuple] = []  # (client, ctx, slot)
         self._b_ids: Dict[Tuple, int] = {}
-        self.decodes: List[Tuple] = []  # (root, idxs, [shards], cb)
+        self.decodes: List[Tuple] = []  # (root, idxs, [shards], cb, n)
         self.shares: List[Tuple] = []  # (pub, base, ctx, senders, shs, cb)
         self.clients: List[object] = []  # drained clients, arrival order
 
@@ -159,8 +159,13 @@ class HubWave:
             slots.append((root, leaf, branch, index))
         self.b_items.append((client, ctx, slot))
 
-    def add_decode(self, root: bytes, idxs: tuple, shards: list, cb) -> None:
-        self.decodes.append((root, idxs, shards, cb))
+    def add_decode(
+        self, root: bytes, idxs: tuple, shards: list, cb, n=None
+    ) -> None:
+        # ``n`` is the requesting instance's roster width (dynamic
+        # membership: epochs under different roster versions carry
+        # different RS geometries; None = the hub's native width)
+        self.decodes.append((root, idxs, shards, cb, n))
 
     def add_share(
         self, pub, base: int, context: bytes, senders: list, shares: list,
@@ -212,6 +217,10 @@ class CryptoHub:
     def __init__(self, crypto: BatchCrypto, dedup: bool = False):
         self.crypto = crypto
         self.dedup = dedup
+        # (n, k) -> BatchCrypto for decode groups whose RS geometry
+        # differs from the native one (dynamic membership: epochs
+        # under a resized roster version)
+        self._crypto_cache: Dict[Tuple[int, int], BatchCrypto] = {}
         if dedup:
             self._share_memo = _Memo(SHARE_MEMO_CAP)
             self._branch_memo = _Memo(BRANCH_MEMO_CAP)
@@ -487,13 +496,13 @@ class CryptoHub:
             _miss = object()
             fresh: List[Tuple] = []
             keys = []
-            for root, idxs, shards, _cb in items:
+            for root, idxs, shards, _cb, n in items:
                 key = (root, idxs)
                 keys.append(key)
                 if key not in local:
                     hit = memo.get(key, _miss)
                     if hit is _miss:
-                        fresh.append((root, idxs, shards, key))
+                        fresh.append((root, idxs, shards, key, n))
                         local[key] = None  # filled by decode below
                     else:
                         local[key] = hit
@@ -514,11 +523,17 @@ class CryptoHub:
         self._decode_groups(items, lambda it, row: it[3](row))
 
     def _decode_groups(self, items: List[Tuple], deliver: Callable) -> None:
-        groups: Dict[Tuple[int, int], List[Tuple]] = {}
+        # grouped by (roster width, k, shard length): epochs under
+        # different roster versions (dynamic membership) carry
+        # different RS geometries and must not share a coder dispatch
+        groups: Dict[Tuple[int, int, int], List[Tuple]] = {}
         for item in items:
             idxs, shards = item[1], item[2]
-            groups.setdefault((len(idxs), len(shards[0])), []).append(item)
-        for group in groups.values():
+            n = item[4] if len(item) > 4 else None
+            groups.setdefault(
+                (n, len(idxs), len(shards[0])), []
+            ).append(item)
+        for (n, _k, _length), group in groups.items():
             k, length = len(group[0][1]), len(group[0][2][0])
             idx_arr = np.asarray([it[1] for it in group])
             # one join+frombuffer for the whole group's matrices (the
@@ -528,12 +543,29 @@ class CryptoHub:
                 b"".join(s for it in group for s in it[2]),
                 dtype=np.uint8,
             ).reshape(len(group), k, length)
-            data, roots, dispatches = self.crypto.decode_recheck_batch(
-                idx_arr, shard_arr
-            )
+            data, roots, dispatches = self._crypto_for(
+                n, k
+            ).decode_recheck_batch(idx_arr, shard_arr)
             self.dispatches += dispatches
             for it, row, root in zip(group, data, roots):
                 deliver(it, row if root.tobytes() == it[0] else None)
+
+    def _crypto_for(self, n, k):
+        """The BatchCrypto whose erasure geometry matches one decode
+        group: the hub's native one when (n, k) agree (every request
+        before a reconfig, and all of them on fixed rosters), else a
+        cached per-geometry sibling on the same backend."""
+        c = self.crypto
+        if n is None or (n == c.n and k == c.k):
+            return c
+        hit = self._crypto_cache.get((n, k))
+        if hit is None:
+            hit = BatchCrypto(
+                c.backend, n, (n - k) // 2, k,
+                mesh_shape=c.mesh_shape,
+            )
+            self._crypto_cache[(n, k)] = hit
+        return hit
 
     def _run_shares(self, items: List[Tuple]) -> None:
         """ALL pooled threshold shares (TPKE decryption + BBA coins,

@@ -511,6 +511,7 @@ class RBC:
                 idxs,
                 [shards_map[i] for i in idxs],
                 self._make_decode_cb(root),
+                n=self.n,
             )
 
     def on_branch_verdicts(self, ctxs, oks) -> None:

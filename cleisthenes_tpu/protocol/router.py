@@ -56,13 +56,17 @@ from cleisthenes_tpu.transport.message import (
     RbcPayload,
     RbcType,
     ReadyBatchPayload,
+    ResharePayload,
 )
 
 # the scalar chain handles these outside the epoch demux entirely
+# (CATCHUP state transfer + reconfig gossip: epoch-unscoped, rare,
+# and order-sensitive relative to the columns around them)
 _CATCHUP_PAYLOADS = (
     CatchupReqPayload,
     CatchupRespPayload,
     CatchupOrdPayload,
+    ResharePayload,
 )
 
 # kind tags (the router's column vocabulary); dispatch happens in
@@ -204,11 +208,16 @@ class WaveRouter:
         scalar arm."""
         hb = self._hb
         es = hb._epochs.get(epoch) or hb._epoch_state(epoch)
-        if es is None:  # outside the sliding window
-            if epoch > hb.epoch + hb.EPOCH_HORIZON:
+        if es is None:  # outside the sliding window, or not a member
+            if epoch > hb.epoch + hb.EPOCH_HORIZON or (
+                epoch > hb.epoch
+                and not hb.roster_for(epoch).local
+            ):
                 # per-payload sightings: the CATCHUP renudge cadence
                 # is counted in payloads, and must tick identically
-                # under either routing arm
+                # under either routing arm (the second arm is the
+                # dynamic-membership joiner watching epochs it cannot
+                # participate in run ahead of its adopted frontier)
                 for _ in items:
                     hb._note_farahead()
             return

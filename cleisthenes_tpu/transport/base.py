@@ -215,12 +215,30 @@ class HmacAuthenticator(Authenticator):
     def __init__(self, self_id: str, peer_keys: "Dict[str, bytes]"):
         self._self_id = self_id
         self._peer_keys = dict(peer_keys)
-        # per-peer precomputed HMAC key schedules (the roster is
-        # fixed; see _hmac_sha256_fn)
+        # per-peer precomputed HMAC key schedules (the roster changes
+        # only at reconfig boundaries; see _hmac_sha256_fn)
         self._macs: "Dict[str, Callable[[bytes], bytes]]" = {
             peer: _hmac_sha256_fn(key)
             for peer, key in self._peer_keys.items()
         }
+
+    def set_peer_key(self, peer_id: str, key: bytes) -> None:
+        """Install (or rotate) one pair key — the dynamic-membership
+        seam: a RECONFIG ceremony derives fresh pair keys for joiner
+        pairs and installs them here the moment the roster change is
+        discovered, so a joiner's CATCHUP traffic authenticates before
+        its activation epoch.  Single-assignment per peer per call;
+        in-flight frames MAC'd under a replaced key are rejected, the
+        same fate as any stale-roster frame."""
+        self._peer_keys[peer_id] = key
+        self._macs[peer_id] = _hmac_sha256_fn(key)
+
+    def drop_peer(self, peer_id: str) -> None:
+        """Retire one pair key: frames to/from the peer no longer
+        sign or verify (the MAC-layer half of peer retirement —
+        transport.health tears down the dial half)."""
+        self._peer_keys.pop(peer_id, None)
+        self._macs.pop(peer_id, None)
 
     @staticmethod
     def pair_key(master_secret: bytes, a: str, b: str) -> bytes:

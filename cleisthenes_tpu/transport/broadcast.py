@@ -53,6 +53,12 @@ class ChannelBroadcaster:
         self._node_id = node_id
         self._members: List[str] = sorted(member_ids)
 
+    def set_members(self, member_ids: Sequence[str]) -> None:
+        """Swap the broadcast target set (dynamic membership: the
+        roster at an activation boundary; CoalescingBroadcaster
+        propagates its own set_members here)."""
+        self._members = sorted(member_ids)
+
     def _wrap(self, payload: Payload) -> Message:
         return Message(
             sender_id=self._node_id, timestamp=time.time(), payload=payload
@@ -205,6 +211,21 @@ class CoalescingBroadcaster:
         # "transport/flush" span covering fold + envelope encode + MAC
         # + post for the wave.  None = tracing off.
         self.trace = trace
+
+    def set_members(self, member_ids: Sequence[str]) -> None:
+        """Swap the receiver set at a roster-activation boundary
+        (dynamic membership).  Flushes buffered payloads FIRST — they
+        belong to waves addressed under the outgoing roster — then
+        rebuilds the per-receiver buffers and propagates to the inner
+        broadcaster when it exposes ``set_members`` (the in-proc
+        ChannelBroadcaster; the gRPC pool derives its receiver set
+        from dialed connections instead)."""
+        self.flush()
+        self._members = sorted(member_ids)
+        self._extras = {m: [] for m in self._members}
+        inner_set = getattr(self._inner, "set_members", None)
+        if inner_set is not None:
+            inner_set(self._members)
 
     def broadcast(self, payload: Payload) -> None:
         self._shared.append(payload)

@@ -118,18 +118,26 @@ class _PeerHealth:
             self.since = time.monotonic()
 
 
-@guarded_by("_lock", "_peers")
+@guarded_by("_lock", "_peers", "_retired")
 class PeerHealthTracker:
     """Thread-safe per-peer health registry for one validator host.
 
     Writers are the dial paths (connect loop, redial threads, stream
-    loss callbacks); readers are Metrics.snapshot() and tests.
+    loss callbacks) and the retirement path (dynamic membership);
+    readers are Metrics.snapshot() and tests.
     """
 
     def __init__(self, peer_ids=()) -> None:
         self._peers: Dict[str, _PeerHealth] = {
             p: _PeerHealth() for p in peer_ids
         }
+        # peers removed from the roster (RECONFIG retirement): their
+        # health rows are dropped from snapshots and every later dial
+        # event for them is ignored — without the flag, a racing
+        # redial thread's dial_failed() would silently resurrect the
+        # row and the backoff loop would hammer a host that is GONE,
+        # forever (the redial-storm the retirement satellite kills)
+        self._retired: set = set()
         self._lock = threading.Lock()
 
     def _peer_locked(self, peer_id: str) -> _PeerHealth:
@@ -140,20 +148,46 @@ class PeerHealthTracker:
             ph = self._peers[peer_id] = _PeerHealth()
         return ph
 
+    def retire(self, peer_id: str) -> None:
+        """Peer left the roster: drop its health state and ignore
+        every later dial event for it.  Idempotent."""
+        with self._lock:
+            self._retired.add(peer_id)
+            self._peers.pop(peer_id, None)
+
+    def readmit(self, peer_id: str) -> None:
+        """Un-retire: a later RECONFIG re-admitted the id.  The peer
+        starts from a fresh (DEGRADED-until-dialed) health row, like
+        any new joiner."""
+        with self._lock:
+            self._retired.discard(peer_id)
+
+    def is_retired(self, peer_id: str) -> bool:
+        """Dial loops poll this to cancel their backoff (a retired
+        peer must stop generating redial storms)."""
+        with self._lock:
+            return peer_id in self._retired
+
     def dial_scheduled(self, peer_id: str, delay_s: float) -> None:
         """A redial was scheduled ``delay_s`` in the future: record the
         backoff curve (the anti-spinning evidence)."""
         with self._lock:
+            if peer_id in self._retired:
+                return
             ph = self._peer_locked(peer_id)
             ph.recent_delays.append(delay_s)
             del ph.recent_delays[:-_DELAY_KEEP]
 
     def dial_started(self, peer_id: str) -> None:
         with self._lock:
+            if peer_id in self._retired:
+                return
             self._peer_locked(peer_id).dial_attempts += 1
 
     def dial_failed(self, peer_id: str) -> None:
         with self._lock:
+            if peer_id in self._retired:
+                return
             ph = self._peer_locked(peer_id)
             ph.dial_failures += 1
             ph.consecutive_failures += 1
@@ -165,6 +199,8 @@ class PeerHealthTracker:
 
     def connected(self, peer_id: str) -> None:
         with self._lock:
+            if peer_id in self._retired:
+                return
             ph = self._peer_locked(peer_id)
             if ph.ever_up and ph.state != UP:
                 # re-establishment, not the boot-time first connect
@@ -175,11 +211,15 @@ class PeerHealthTracker:
 
     def stream_lost(self, peer_id: str) -> None:
         with self._lock:
+            if peer_id in self._retired:
+                return
             ph = self._peer_locked(peer_id)
             ph._enter(DEGRADED)
 
     def state(self, peer_id: str) -> str:
         with self._lock:
+            if peer_id in self._retired:
+                return DOWN  # reported, never re-created
             return self._peer_locked(peer_id).state
 
     def snapshot(self) -> Dict[str, Dict[str, object]]:

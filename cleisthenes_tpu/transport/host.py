@@ -286,11 +286,14 @@ class ValidatorHost:
         auto_propose: bool = True,
         batch_log_path: Optional[str] = None,
         behavior=None,
+        joining: bool = False,
+        roster_version_base: int = 0,
     ) -> None:
         self.config = config
         self.node_id = node_id
         self.members = sorted(member_ids)
         self.keys = keys
+        self._joining = joining
         self._addrs: Dict[str, str] = {}
         self._stopping = threading.Event()
         self.log = NodeLogger(node_id, "host")
@@ -334,6 +337,10 @@ class ValidatorHost:
             from cleisthenes_tpu.core.ledger import BatchLog
 
             batch_log = BatchLog(batch_log_path, fsync=config.ledger_fsync)
+        # peers retired by a RECONFIG: redial loops check the set and
+        # cancel; guarded by the health tracker's own lock discipline
+        # (writes happen on the dispatch thread, reads on dial threads
+        # via PeerHealthTracker.is_retired)
         self.node = HoneyBadger(
             config=config,
             node_id=node_id,
@@ -346,7 +353,15 @@ class ValidatorHost:
             # behavior objects the in-proc cluster mounts run over real
             # gRPC — a lie per receiver, each frame validly MAC'd
             behavior=behavior,
+            authenticator=self._auth,
+            joining=joining,
+            roster_version_base=roster_version_base,
         )
+        # dynamic-membership transport hooks: a discovered joiner gets
+        # a dial lane (the redial loop completes its CATCHUP on
+        # success); a torn-down retiree stops being dialed
+        self.node.on_peer_added = self.add_peer
+        self.node.on_peer_retired = self.retire_peer
         self.node.metrics.set_transport_health(self.health.snapshot)
         self.node.metrics.set_transport_stats(self._transport_stats)
         # SLO watchdogs (utils/watchdog.py) run on every host: alert
@@ -485,9 +500,10 @@ class ValidatorHost:
                         raise
         self.out.mark_ready()
         self.log.info("connected", peers=len(self.pool))
-        if self.node.epoch > 0:
-            # restarted from a durable log: peers may have committed
-            # epochs we missed — catch up before proposing
+        if self.node.epoch > 0 or self._joining:
+            # restarted from a durable log — or a JOINER bootstrapping
+            # into a running roster: peers may have committed epochs
+            # we missed — catch up before proposing
             self.dispatcher.call(self.node.request_catchup)
 
     def _backoff_for(self, member: str) -> Backoff:
@@ -549,8 +565,8 @@ class ValidatorHost:
             self.pool.remove(member)
         self.health.stream_lost(member)
         self.log.warning("peer stream lost", peer=member)
-        if self._stopping.is_set():
-            return
+        if self._stopping.is_set() or self.health.is_retired(member):
+            return  # a retired peer's lost stream stays lost
         threading.Thread(
             target=self._redial_loop, args=(member,), daemon=True
         ).start()
@@ -562,6 +578,11 @@ class ValidatorHost:
         dial attempts (transport/health.py)."""
         backoff = self._backoff_for(member)
         while not self._stopping.is_set():
+            if self.health.is_retired(member):
+                # peer left the roster while we were backing off:
+                # cancel the loop — a retired host must not keep
+                # absorbing this roster's redial storms
+                return
             try:
                 conn = self._dial_member(member)
             except Exception:
@@ -570,7 +591,9 @@ class ValidatorHost:
                 if self._stopping.wait(delay):
                     return
                 continue
-            if self._stopping.is_set():  # stop() raced the dial
+            if self._stopping.is_set() or self.health.is_retired(
+                member
+            ):  # stop()/retirement raced the dial
                 self.pool.remove(member)
                 conn.close()
                 return
@@ -581,6 +604,44 @@ class ValidatorHost:
                 lambda m=member: self.node.peer_reconnected(m)
             )
             return
+
+    def add_peer(self, member: str, addr: str) -> None:
+        """Dynamic membership: open a dial lane to a discovered
+        JOINER.  The redial loop dials with the standard capped
+        backoff until the joiner's server answers, then fires
+        ``peer_reconnected`` — which serves the joiner's standing
+        CATCHUP-from-0 request, completing its bootstrap."""
+        if member == self.node_id or self._stopping.is_set():
+            return
+        # an id retired by an EARLIER reconfig may be re-admitted by
+        # a later one: lift the retirement before the dial loop's
+        # is_retired checks would cancel it
+        self.health.readmit(member)
+        if member not in self.members:
+            self.members = sorted(set(self.members) | {member})
+        self._addrs[member] = addr
+        if self.pool.get(member) is not None:
+            return  # already connected
+        threading.Thread(
+            target=self._redial_loop, args=(member,), daemon=True
+        ).start()
+
+    def retire_peer(self, member: str) -> None:
+        """Dynamic membership: the peer left the roster and every
+        pre-boundary epoch is settled.  Tear down its dial state —
+        the backoff loop cancels, the pooled stream closes, and its
+        health row drops from ``transport_health`` — so a retired
+        host stops generating redial storms the moment its duties
+        end."""
+        self.health.retire(member)
+        self._addrs.pop(member, None)
+        if member in self.members:
+            self.members = sorted(set(self.members) - {member})
+        conn = self.pool.get(member)
+        if conn is not None:
+            self.pool.remove(member)
+            conn.close()
+        self.log.info("peer retired", peer=member)
 
     def stop(self) -> None:
         self._stopping.set()

@@ -170,6 +170,26 @@ class CatchupOrdPayload(NamedTuple):
     body: bytes
 
 
+class ResharePayload(NamedTuple):
+    """One dealer's reshare dealing for a pending RECONFIG (dynamic
+    membership, protocol.reconfig).
+
+    ``body`` is the full serialized dealing (Feldman commitments for
+    the new TPKE and coin keys plus the per-receiver encrypted share
+    blobs) — the exact bytes the dealer also submits as its dealing
+    transaction.  The broadcast is the EAGER in-band distribution
+    path: live nodes stage and pre-verify dealings while the old
+    roster keeps committing, and a joiner receiving one learns a
+    ceremony is underway and (re)starts its CATCHUP bootstrap.  The
+    authoritative copy — the one qualified-set selection is judged on
+    — is the committed dealing transaction, so a lost broadcast costs
+    latency, never agreement."""
+
+    version: int
+    dealer: str
+    body: bytes
+
+
 class BundlePayload(NamedTuple):
     """Several protocol payloads in ONE authenticated envelope.
 
@@ -268,6 +288,7 @@ Payload = Union[
     CatchupReqPayload,
     CatchupRespPayload,
     CatchupOrdPayload,
+    ResharePayload,
     BundlePayload,
     BbaBatchPayload,
     CoinBatchPayload,
@@ -291,6 +312,7 @@ _KIND_DEC_BATCH = 12
 _KIND_READY_BATCH = 13
 _KIND_ECHO_BATCH = 14
 _KIND_CATCHUP_ORD = 15
+_KIND_RESHARE = 16
 
 # DoS bound on per-instance columns (a roster is <= 256 under the
 # GF(2^8) shard cap; 4096 leaves margin for multi-round merges)
@@ -440,6 +462,11 @@ def _encode_payload(p: Payload) -> Tuple[int, bytes]:
         out.append(struct.pack(">Q", p.epoch))
         _pack_bytes(out, p.body)
         return _KIND_CATCHUP_ORD, b"".join(out)
+    if isinstance(p, ResharePayload):
+        out.append(struct.pack(">I", p.version))
+        _pack_str(out, p.dealer)
+        _pack_bytes(out, p.body)
+        return _KIND_RESHARE, b"".join(out)
     if isinstance(p, BundlePayload):
         if len(p.items) > MAX_BUNDLE_ITEMS:
             raise ValueError(f"bundle of {len(p.items)} items exceeds cap")
@@ -745,6 +772,13 @@ def _parse_payload(d: bytes, o: int, end: int, kind: int):
         (epoch,) = _U64.unpack_from(d, o)
         body, o = _field(d, o + 8, end)
         return CatchupOrdPayload(epoch, body), o
+    if kind == _KIND_RESHARE:
+        if o + 4 > end:
+            raise ValueError("truncated frame")
+        (version,) = _U32.unpack_from(d, o)
+        dealer, o = _field(d, o + 4, end)
+        body, o = _field(d, o, end)
+        return ResharePayload(version, dealer.decode("utf-8"), body), o
     if kind == _KIND_BUNDLE:
         if o + 4 > end:
             raise ValueError("truncated frame")
@@ -991,6 +1025,7 @@ __all__ = [
     "CatchupReqPayload",
     "CatchupRespPayload",
     "CatchupOrdPayload",
+    "ResharePayload",
     "BundlePayload",
     "BbaBatchPayload",
     "CoinBatchPayload",

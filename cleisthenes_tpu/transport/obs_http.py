@@ -203,6 +203,27 @@ def render_prometheus(targets: Sequence[ObsTarget]) -> str:
             labels,
             int(frontiers["decrypt_lag_epochs"]),
         )
+        # dynamic-membership counters (always present — zeroed on
+        # fixed-roster nodes per the schema-stability rule)
+        reconfig = snap["reconfig"]
+        exp.add(
+            exp.family(
+                "roster_version", "gauge",
+                "the ACTIVE roster version (0 = genesis; bumps at "
+                "every RECONFIG activation boundary)",
+            ),
+            labels,
+            int(reconfig["roster_version"]),
+        )
+        exp.add(
+            exp.family(
+                "reconfigs_total", "counter",
+                "completed roster switches activated by this node "
+                "(joins, retirements, re-keys)",
+            ),
+            labels,
+            int(reconfig["reconfigs_total"]),
+        )
         transport = snap["transport"]
         frames = exp.family(
             "transport_frames_total", "counter",
@@ -349,6 +370,13 @@ class ObsServer:
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self.port: Optional[int] = None
+
+    def add_target(self, target: ObsTarget) -> None:
+        """Fold one more node into the scrape (dynamic membership: a
+        JOINER wired in mid-run).  List append is atomic under the
+        GIL and request handlers only iterate, so no lock is needed
+        for the read-mostly pattern here."""
+        self.targets.append(target)
 
     # -- endpoint bodies (also the in-proc testing surface) ----------------
 

@@ -38,9 +38,11 @@ from cleisthenes_tpu.transport.message import (
     Message,
     Payload,
     RbcPayload,
+    ResharePayload,
     _KIND_CATCHUP_ORD,
     _KIND_CATCHUP_REQ,
     _KIND_CATCHUP_RESP,
+    _KIND_RESHARE,
     _encode_payload,
     _decode_payload,
 )
@@ -59,6 +61,9 @@ _PB_TAG_CATCHUP_RESP = 16
 # ciphertext-ordered catch-up (Config.order_then_settle): same TLV-in-
 # field-1 extension shape, next free tag
 _PB_TAG_CATCHUP_ORD = 17
+# dynamic membership: the reshare-dealing gossip kind (same field-1
+# extension shape)
+_PB_TAG_RESHARE = 18
 
 # A Byzantine frame must not make us allocate from a length varint.
 MAX_PB_FIELD = 64 * 1024 * 1024
@@ -152,6 +157,9 @@ def encode_pb_message(msg: Message) -> bytes:
     elif isinstance(p, CatchupOrdPayload):
         _k, tlv = _encode_payload(p)
         one = _len_field(_PB_TAG_CATCHUP_ORD, _len_field(1, tlv))
+    elif isinstance(p, ResharePayload):
+        _k, tlv = _encode_payload(p)
+        one = _len_field(_PB_TAG_RESHARE, _len_field(1, tlv))
     else:
         raise ValueError(
             f"{type(p).__name__} has no slot in the reference's oneof"
@@ -181,7 +189,7 @@ def decode_pb_message(data: bytes, sender_id: str = "") -> Message:
             # compatibility); the KNOWN tags are all length-delimited
             if tag in (
                 1, 2, 3, 4, _PB_TAG_CATCHUP_REQ, _PB_TAG_CATCHUP_RESP,
-                _PB_TAG_CATCHUP_ORD,
+                _PB_TAG_CATCHUP_ORD, _PB_TAG_RESHARE,
             ):
                 raise ValueError(
                     f"wire type {wt} for known tag {tag} (expected LEN)"
@@ -209,7 +217,8 @@ def decode_pb_message(data: bytes, sender_id: str = "") -> Message:
         elif tag in (3, 4):
             payload = _parse_inner(tag, body)
         elif tag in (
-            _PB_TAG_CATCHUP_REQ, _PB_TAG_CATCHUP_RESP, _PB_TAG_CATCHUP_ORD
+            _PB_TAG_CATCHUP_REQ, _PB_TAG_CATCHUP_RESP,
+            _PB_TAG_CATCHUP_ORD, _PB_TAG_RESHARE,
         ):
             payload = _parse_catchup(tag, body)
         # unknown LEN fields are skipped, per proto3 semantics
@@ -240,6 +249,8 @@ def _parse_catchup(tag: int, body: bytes) -> Payload:
         kind = _KIND_CATCHUP_REQ
     elif tag == _PB_TAG_CATCHUP_RESP:
         kind = _KIND_CATCHUP_RESP
+    elif tag == _PB_TAG_RESHARE:
+        kind = _KIND_RESHARE
     else:
         kind = _KIND_CATCHUP_ORD
     return _decode_payload(kind, tlv)

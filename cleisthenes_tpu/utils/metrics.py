@@ -217,6 +217,9 @@ class Metrics:
         # ciphertext ordering committed (the ordered frontier's tally;
         # settlement lands in epochs_committed as before)
         self.epochs_ordered = Counter()
+        # dynamic membership (protocol.reconfig): completed roster
+        # switches this node activated (joins, retirements, re-keys)
+        self.reconfigs_total = Counter()
         # wave-routed ingest (Config.wave_routing): batch handler
         # invocations crossing the router seam into protocol logic
         # (ACS/RBC/BBA/dec-share entry points).  The scalar routing
@@ -265,6 +268,9 @@ class Metrics:
         # the coupled path, bounded by Config.decrypt_lag_max on the
         # order-then-settle path.
         self._frontiers: Optional[Callable[[], Tuple[int, int]]] = None
+        # roster-version provider (set by the owning HoneyBadger):
+        # () -> the ACTIVE roster version (0 = the genesis roster)
+        self._roster_version: Optional[Callable[[], int]] = None
 
     def set_transport_health(
         self, provider: Optional[Callable[[], Dict]]
@@ -288,6 +294,10 @@ class Metrics:
         self, provider: Optional[Callable[[], Tuple[int, int]]]
     ) -> None:
         self._frontiers = provider
+
+    def set_reconfig(self, provider: Optional[Callable[[], int]]) -> None:
+        """Roster-version provider (dynamic membership)."""
+        self._roster_version = provider
 
     def decrypt_lag_epochs(self) -> int:
         """Ordered frontier - settled frontier (0 when no provider is
@@ -401,6 +411,17 @@ class Metrics:
             frontiers["settled_frontier"] = settled
             frontiers["decrypt_lag_epochs"] = max(0, ordered - settled)
         out["frontiers"] = frontiers
+        # reconfig block: ALWAYS present with every key, zeroed on
+        # fixed-roster nodes (the PR-9 schema-stability rule — a
+        # scraper must never see a key appear/disappear between
+        # snapshots because a roster happened to change)
+        reconfig: Dict[str, object] = {
+            "roster_version": 0,
+            "reconfigs_total": self.reconfigs_total.value,
+        }
+        if self._roster_version is not None:
+            reconfig["roster_version"] = int(self._roster_version())
+        out["reconfig"] = reconfig
         # wave-routing block: ALWAYS present with every key, zeroed on
         # the scalar arm / bare nodes (the PR-9 schema-stability rule
         # — scrapers and the timeseries sampler must never see a key

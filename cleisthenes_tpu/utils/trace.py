@@ -73,6 +73,10 @@ CATEGORIES = frozenset(
         "ledger",  # WAL appends / checkpoints
         "catchup",  # state-transfer requests/serves/adopts
         "alert",  # SLO watchdog firings (epoch stall, backpressure…)
+        "reconfig",  # dynamic membership: one "ceremony" span per
+        # reshare (discovery -> qualified set -> finalize) plus
+        # discovered/deal/staged/install/activate/teardown instants
+        # — the roster-switch timeline tools/tracetool.py reports
     )
 )
 

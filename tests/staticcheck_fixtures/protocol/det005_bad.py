@@ -1,0 +1,24 @@
+"""DET005 fixture: epoch-scoped code pinning the construction-time
+roster instead of resolving through the roster-version accessor."""
+
+
+class Node:
+    def __init__(self, config, members, keys):
+        self.config = config
+        self.members = members
+        self._member_set = frozenset(members)
+        self.keys = keys
+
+    def handle_share(self, sender, epoch):
+        if sender not in self._member_set:  # BAD:DET005
+            return None
+        if self.config.n < 4:  # BAD:DET005
+            return None
+        if self.config.f == 0:  # BAD:DET005
+            return None
+        return self.keys  # BAD:DET005
+
+    def serve_column(self, items, expected_epoch):
+        # any epoch-ish parameter scopes the function to one epoch
+        width = self.config.n  # BAD:DET005
+        return [i for i in items][:width]

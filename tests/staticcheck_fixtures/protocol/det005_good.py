@@ -1,0 +1,33 @@
+"""DET005 fixture (clean): epoch-scoped code resolving n/f/keys and
+membership through the epoch's roster view, and active-roster reads
+confined to epoch-UNSCOPED code."""
+
+
+class Node:
+    def __init__(self, config, members, keys):
+        self.config = config
+        self.members = members
+        self._member_set = frozenset(members)
+        self.keys = keys
+
+    def roster_for(self, epoch):
+        return self
+
+    def handle_share(self, sender, epoch, es):
+        view = es.view
+        if sender not in view.member_set:
+            return None
+        if view.config.n < 4:
+            return None
+        if view.config.f == 0:
+            return None
+        return view.keys
+
+    def resolve(self, epoch):
+        # the sanctioned accessor: the view carries the roster
+        view = self.roster_for(epoch)
+        return view.config.n
+
+    def roster_unscoped(self, sender):
+        # no epoch parameter: the ACTIVE roster is exactly right here
+        return sender in self._member_set and self.config.n

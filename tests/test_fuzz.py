@@ -184,3 +184,19 @@ def test_fuzz_deep_sweep():
     for seed in range(20, 220):
         v = run_schedule(sample_schedule(seed))
         assert v is None, f"seed {seed}: {v}"
+
+
+@pytest.mark.slow
+def test_fuzz_reconfig_deep_sweep():
+    """The dynamic-membership deep band: 200 reconfig-bearing
+    schedules — every sampled crash/partition/semantic composite runs
+    ACROSS a join (sometimes composed with a coalition retirement)
+    reshare ceremony, and the invariants (ledger agreement, roster/
+    key agreement, no foreign tx, liveness for the final roster) must
+    span the switch (ci.sh runs the 0:20 smoke band of this sampler;
+    this is the RUN-SLOW extension)."""
+    for seed in range(20, 220):
+        v = run_schedule(
+            sample_schedule(seed, rounds=16, reconfig=True)
+        )
+        assert v is None, f"seed {seed}: {v}"

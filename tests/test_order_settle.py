@@ -335,12 +335,21 @@ def test_grpc_torn_window_restart_settles_from_wal(tmp_path):
             h.stop()
     _tear_last_clog(wals["node0"])
 
+    # ordered-ahead out of WAL replay: the COrd survived the tear.
+    # Asserted on a standalone construction BEFORE any connect —
+    # catch-up fires inside connect() and can settle the epoch within
+    # milliseconds of the dial completing, so asserting after boot()
+    # races the very recovery this test exists to prove.
+    probe = ValidatorHost(cfg, "node0", ids, keys["node0"],
+                          batch_log_path=wals["node0"])
+    assert probe.node.epoch == 1
+    assert probe.node.settled_epoch == 0
+    probe.stop()
+
     hosts2 = boot()
     try:
         victim = hosts2["node0"]
-        # ordered-ahead out of WAL replay: the COrd survived the tear
         assert victim.node.epoch == 1
-        assert victim.node.settled_epoch == 0
         deadline = time.monotonic() + 30
         got = None
         while time.monotonic() < deadline:

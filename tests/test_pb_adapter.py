@@ -19,6 +19,7 @@ from cleisthenes_tpu.transport.message import (
     Message,
     RbcPayload,
     RbcType,
+    ResharePayload,
 )
 from cleisthenes_tpu.transport.pb_adapter import (
     decode_pb_message,
@@ -69,6 +70,7 @@ def test_non_reference_payloads_have_no_slot():
     [
         CatchupReqPayload(from_epoch=9),
         CatchupRespPayload(epoch=4, body=b"ledger-body-bytes"),
+        ResharePayload(version=2, dealer="node001", body=b"dealing"),
     ],
 )
 def test_catchup_extension_slots_roundtrip(payload):

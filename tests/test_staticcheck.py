@@ -77,6 +77,11 @@ BAD_FIXTURES = [
     # router's one-dispatch-per-kind-per-wave discipline can't
     # silently erode back to one Python call chain per payload
     "transport/det004_bad.py",
+    # the roster-version seam (ISSUE 12): epoch-scoped protocol code
+    # reading the construction-time n/f/keys/membership still gates —
+    # a fixed-roster read is correct right up until the first
+    # RECONFIG crosses, then a silent fork
+    "protocol/det005_bad.py",
     "protocol/conc001_bad.py",
     "transport/conc002_bad.py",
     "protocol/err001_bad.py",
@@ -86,6 +91,7 @@ GOOD_FIXTURES = [
     "protocol/det002_good.py",
     "protocol/det003_good.py",
     "transport/det004_good.py",
+    "protocol/det005_good.py",
     "protocol/conc001_good.py",
     "transport/conc002_good.py",
     "protocol/err001_good.py",
@@ -173,6 +179,7 @@ def test_rule_catalog_registered():
         "DET002",
         "DET003",
         "DET004",
+        "DET005",
         "CONC001",
         "CONC002",
         "ERR001",

@@ -95,13 +95,22 @@ class Violation(Exception):
 # ---------------------------------------------------------------------------
 
 
-def sample_schedule(seed: int, n: int = 4, rounds: int = 12) -> dict:
+def sample_schedule(
+    seed: int, n: int = 4, rounds: int = 12, reconfig: bool = False
+) -> dict:
     """One composite fault schedule, a pure function of ``seed``.
 
     All faults — semantic behaviors, wire stages, crash/partition
     timeline — are confined to ONE f-sized coalition, so the honest
     majority keeps its HBBFT guarantees and the liveness invariant is
-    legitimately enforceable."""
+    legitimately enforceable.
+
+    ``reconfig=True`` (the dynamic-membership band) additionally
+    schedules one roster-change event — a joiner, sometimes composed
+    with the retirement of a COALITION member — so crash/partition/
+    semantic schedules run ACROSS a reshare ceremony and an
+    activation boundary, and the safety invariants span the roster
+    change."""
     rng = random.Random(seed)
     f = (n - 1) // 3
     ids = [f"node{i:03d}" for i in range(n)]
@@ -151,6 +160,18 @@ def sample_schedule(seed: int, n: int = 4, rounds: int = 12) -> dict:
                 "peer": peer,
             }
         )
+    if reconfig:
+        honest_now = [i for i in ids if i not in bad]
+        ev = {
+            "round": rng.randrange(1, 4),
+            "op": "reconfig",
+            "node": honest_now[0],  # submit via a surviving honest node
+            "join": [f"nodeJ{seed % 100:02d}"],
+            "retire": (
+                [rng.choice(bad)] if bad and rng.random() < 0.5 else []
+            ),
+        }
+        timeline.append(ev)
     timeline.sort(key=lambda ev: (ev["round"], ev["op"], ev["node"]))
 
     return {
@@ -213,8 +234,9 @@ def _build_cluster(schedule: dict, trace: bool) -> SimulatedCluster:
     return cluster
 
 
-def _apply_event(net, ev: dict) -> None:
+def _apply_event(cluster, ev: dict) -> None:
     op = ev["op"]
+    net = cluster.net
     if op == "crash":
         net.crash(ev["node"])
     elif op == "recover":
@@ -223,13 +245,30 @@ def _apply_event(net, ev: dict) -> None:
         net.partition(ev["node"], ev["peer"])
     elif op == "heal":
         net.heal(ev["node"], ev["peer"])
+    elif op == "reconfig":
+        # dynamic membership: joiners wire in, the RECONFIG tx is
+        # submitted via the named (honest, surviving) node, and the
+        # in-band reshare ceremony runs composed with whatever other
+        # faults the schedule mounts
+        cluster.begin_reconfig(
+            join=ev.get("join", ()),
+            retire=ev.get("retire", ()),
+            submit_via=ev["node"],
+        )
     else:
         raise ValueError(f"unknown timeline op {op!r}")
 
 
 def _check_safety(cluster, honest: List[str], submitted: set, rnd: int):
-    """Raise Violation on any safety breach at this quiescence point."""
+    """Raise Violation on any safety breach at this quiescence point.
+
+    ``honest`` is the STATIC honest list; joiners added mid-run by a
+    reconfig event are honest by construction and fold in here, so
+    the agreement/no-foreign-tx/roster invariants span the roster
+    change (a joiner still bootstrapping contributes depth 0 and
+    tightens nothing until it adopts)."""
     from cleisthenes_tpu.core.ledger import decode_ordered_body
+    from cleisthenes_tpu.protocol.reconfig import is_protocol_tx
 
     nodes = cluster.nodes
     depth = min(len(nodes[h].committed_batches) for h in honest)
@@ -247,13 +286,37 @@ def _check_safety(cluster, honest: List[str], submitted: set, rnd: int):
     for h in honest:
         for e, batch in enumerate(nodes[h].committed_batches):
             for tx in batch.tx_list():
-                if tx not in submitted:
+                if tx not in submitted and not is_protocol_tx(tx):
+                    # reconfig-machinery txs (RECONFIG + dealings)
+                    # are node-originated, never client-submitted
                     raise Violation(
                         "no_foreign_tx",
                         f"{h} committed unsubmitted tx {tx!r} "
                         f"in epoch {e}",
                         rnd,
                     )
+    # -- roster agreement (dynamic membership) ------------------------
+    # every honest node that installed a roster version agrees on its
+    # activation epoch and key-material digest (the committed ceremony
+    # is one log; divergent keys would be a consensus fork in disguise)
+    versions: Dict[int, tuple] = {}
+    for h in honest:
+        for rv in nodes[h].rosters:
+            if not rv.key_material_digest:
+                # synthetic genesis record (a joiner's base version
+                # carries no ceremony material), never comparable to
+                # the real installed version of the same number
+                continue
+            got = (rv.activation_epoch, rv.member_ids,
+                   rv.key_material_digest)
+            want = versions.setdefault(rv.version, got)
+            if got != want:
+                raise Violation(
+                    "roster_agreement",
+                    f"{h} roster v{rv.version} diverges "
+                    f"(activation/members/keys)",
+                    rnd,
+                )
     # -- two-frontier invariants (ISSUE 8, Config.order_then_settle) --
     lag_max = cluster.config.decrypt_lag_max
     ordered_depth = max(nodes[h].epoch for h in honest)
@@ -334,10 +397,13 @@ def run_schedule(
 
     def before_round(r: int) -> None:
         for ev in by_round.get(r, ()):
-            _apply_event(cluster.net, ev)
+            _apply_event(cluster, ev)
 
     def on_quiescence(r: int) -> None:
-        _check_safety(cluster, honest, submitted, r)
+        # recomputed per round: a reconfig event adds joiners (honest
+        # by construction) to the cluster mid-run
+        cur = [nid for nid in sorted(cluster.nodes) if nid not in bad]
+        _check_safety(cluster, cur, submitted, r)
 
     violation: Optional[dict] = None
     rounds_used = schedule["rounds"]
@@ -353,7 +419,18 @@ def run_schedule(
     except Violation as v:
         violation = v.report
     if violation is None and schedule.get("check_liveness", True):
-        for h in honest:
+        # liveness spans the roster change: every honest node that is
+        # (still) a member at the end — original members AND joiners —
+        # must hold every submitted tx.  A retired honest node stops
+        # at its activation boundary by design, so it is exempt from
+        # the tail (the sampler only retires coalition members, but
+        # the rule is stated generally for hand-written schedules).
+        final = [
+            nid
+            for nid in sorted(cluster.nodes)
+            if nid not in bad and not cluster.nodes[nid]._retired_self
+        ]
+        for h in final:
             committed = {
                 tx
                 for b in cluster.nodes[h].committed_batches
@@ -468,6 +545,7 @@ def fuzz_seeds(
     rounds: int = 12,
     out_dir: Optional[str] = None,
     trace: bool = True,
+    reconfig: bool = False,
 ) -> int:
     """Run a schedule per seed; on the first violation, shrink it and
     emit a repro file plus (by default) a flight-recorder trace
@@ -476,7 +554,9 @@ def fuzz_seeds(
     import pathlib
 
     for seed in seeds:
-        schedule = sample_schedule(seed, n=n, rounds=rounds)
+        schedule = sample_schedule(
+            seed, n=n, rounds=rounds, reconfig=reconfig
+        )
         violation = run_schedule(schedule)
         if violation is None:
             print(f"seed {seed:6d}: ok")
@@ -504,6 +584,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap.add_argument("--seed", type=int, help="single seed")
     ap.add_argument("--n", type=int, default=4, help="cluster size")
     ap.add_argument("--rounds", type=int, default=12)
+    ap.add_argument(
+        "--reconfig",
+        action="store_true",
+        help="dynamic-membership band: compose a join/retire "
+        "reconfig event into every sampled schedule",
+    )
     ap.add_argument(
         "--show", action="store_true", help="print the schedule, no run"
     )
@@ -539,7 +625,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     if args.show:  # print the sampled schedule(s), run nothing
         for seed in seeds:
-            schedule = sample_schedule(seed, n=args.n, rounds=args.rounds)
+            schedule = sample_schedule(
+                seed, n=args.n, rounds=args.rounds,
+                reconfig=args.reconfig,
+            )
             json.dump(schedule, sys.stdout, indent=2, sort_keys=True)
             print()
         return 0
@@ -549,6 +638,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         rounds=args.rounds,
         out_dir=args.out,
         trace=not args.no_trace,
+        reconfig=args.reconfig,
     )
 
 

@@ -350,6 +350,100 @@ class Det004WaveIngestSeam:
 
 
 # ---------------------------------------------------------------------------
+# DET005: epoch-scoped code must resolve the roster through the
+# roster-version accessor
+# ---------------------------------------------------------------------------
+#
+# Dynamic membership (ISSUE 12) made the roster a VERSIONED value:
+# every epoch resolves n/f/keys/membership through
+# ``roster_for(epoch)`` / the epoch state's ``view``.  A direct read
+# of the construction-time constants (``self.config.n``,
+# ``self.config.f``, ``self.members``, ``self._member_set``,
+# ``self.keys``) from code that handles a PARTICULAR epoch silently
+# re-pins the roster to whatever was active at construction — correct
+# right up until the first RECONFIG crosses, then a fork/liveness
+# bug that only a roster-change schedule can catch.  The rule flags
+# those reads inside any function that takes an epoch parameter, in
+# the protocol files whose objects OUTLIVE epochs; per-epoch
+# instances (ACS/RBC/BBA and their banks — constructed WITH a
+# version's config) are exempt, as is the reshare plane itself.
+
+_DET005_EXEMPT_FILES = frozenset(
+    (
+        "acs.py",  # per-epoch: constructed with the epoch's view
+        "rbc.py",
+        "bba.py",
+        "echobank.py",
+        "votebank.py",
+        "hub.py",  # roster-agnostic batch executor (geometry rides
+        # with each request)
+        "spmd.py",  # lockstep executor: fixed-roster by definition
+        "byzantine.py",  # adversary plane: lies are the point
+        "reconfig.py",  # the accessor's own implementation layer
+    )
+)
+_DET005_CONFIG_FIELDS = frozenset(("n", "f", "decryption_threshold"))
+_DET005_SELF_ATTRS = frozenset(("members", "_member_set", "keys"))
+
+
+@rule
+class Det005RosterVersionAccessor:
+    id = "DET005"
+    doc = (
+        "epoch-scoped protocol code (functions taking an epoch "
+        "parameter) must resolve n/f/keys/membership via "
+        "roster_for(epoch) / the epoch state's view, not the "
+        "construction-time self.config.n / self.members / self.keys"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        parts = ctx.relpath.split("/")
+        if "protocol" not in parts or parts[-1] in _DET005_EXEMPT_FILES:
+            return
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            args = fn.args
+            names = [
+                a.arg
+                for a in (
+                    args.posonlyargs + args.args + args.kwonlyargs
+                )
+            ]
+            if not any("epoch" in a for a in names):
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Attribute):
+                    continue
+                inner = node.value
+                # self.config.n / self.config.f / ...
+                if (
+                    node.attr in _DET005_CONFIG_FIELDS
+                    and isinstance(inner, ast.Attribute)
+                    and inner.attr == "config"
+                    and _self_attr(inner) == "config"
+                ):
+                    yield ctx.finding(
+                        self.id,
+                        node,
+                        f"epoch-scoped {fn.name}() reads "
+                        f"self.config.{node.attr}; resolve the "
+                        "epoch's roster via roster_for(epoch)/"
+                        "es.view instead",
+                    )
+                # self.members / self._member_set / self.keys
+                elif _self_attr(node) in _DET005_SELF_ATTRS:
+                    yield ctx.finding(
+                        self.id,
+                        node,
+                        f"epoch-scoped {fn.name}() reads "
+                        f"self.{node.attr} (the ACTIVE roster); "
+                        "resolve the epoch's roster via "
+                        "roster_for(epoch)/es.view instead",
+                    )
+
+
+# ---------------------------------------------------------------------------
 # CONC001: lock discipline for @guarded_by-annotated attributes
 # ---------------------------------------------------------------------------
 #
@@ -582,6 +676,7 @@ __all__ = [
     "Det001WallClockAndEntropy",
     "Det002SetIterationOrder",
     "Det003HubColumnarSeam",
+    "Det005RosterVersionAccessor",
     "Conc001LockDiscipline",
     "Conc002BlockingInHandlers",
     "Err001SwallowedExceptions",
