@@ -377,6 +377,25 @@ def measure_protocol(
         / run_epochs,
         1,
     )
+    # egress columnarization (ISSUE 13): outbound payload bodies
+    # actually encoded, Authenticator sign passes, the encode memo's
+    # hit rate, and native coin-share issue dispatches — deterministic
+    # for the seeded schedule, cluster-wide, per epoch (the numbers
+    # the egress/coin wave batching exists to collapse)
+    out["frames_encoded_per_epoch"] = round(
+        dstats["frames_encoded"] / run_epochs, 1
+    )
+    out["mac_signs_per_epoch"] = round(
+        dstats["mac_signs"] / run_epochs, 1
+    )
+    eprobes = dstats["encode_memo_hits"] + dstats["encode_memo_misses"]
+    out["encode_memo_hit_rate"] = (
+        round(dstats["encode_memo_hits"] / eprobes, 4) if eprobes else 0.0
+    )
+    out["coin_dispatches_per_epoch"] = round(
+        nodes[node_ids[0]].hub.stats()["coin_issue_batches"] / run_epochs,
+        1,
+    )
     out.update(two_frontier_keys(nodes[node_ids[0]].metrics))
     if trace:
         from cleisthenes_tpu.utils.trace import to_chrome

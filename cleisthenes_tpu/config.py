@@ -113,6 +113,12 @@ class Config:
         WaveRouter instead of one Python call chain per payload (see
         the field comment below).  False is the scalar per-payload
         routing comparison arm.
+      egress_columnar: columnar outbound plane — one batched
+        encode+MAC-sign pass per node per wave (Authenticator
+        .sign_wire_wave + FrameEncodeMemo), coalesced frame writes,
+        and wave-batched native coin-share issue through the hub's
+        coin column (see the field comment below).  False is the
+        scalar per-send egress comparison arm.
     """
 
     n: int = 4
@@ -189,6 +195,28 @@ class Config:
     # must commit byte-identical ledgers under either arm;
     # tests/test_delivery_equivalence.py).
     wave_routing: bool = True
+    # Egress columnarization (the send-side twin of delivery_columnar,
+    # mirroring PR 9 on the outbound path): the CoalescingBroadcaster
+    # hands each flush's whole wave of folded bundles to ONE
+    # Authenticator.sign_wire_wave call per node per wave — the
+    # envelope body encodes once per distinct payload object (the
+    # shared-prefix FrameEncodeMemo, transport.message) and the
+    # per-receiver HMACs run as one batched pass over the PR-7
+    # precomputed key schedules — and the resulting frames coalesce
+    # into one write per peer per flush on both transports (one
+    # pending-queue post carrying the wave on ChannelNetwork; one
+    # stream write per peer on the gRPC send loop).  The same flag
+    # routes the protocol plane's pending coin-share issues through
+    # the CryptoHub's coin work column (ops.coin.share_batch): a
+    # wave's coin issues across ALL BBA instances and rounds execute
+    # as one native multi-exponentiation dispatch with one CP-nonce
+    # draw, instead of one issue_shares_batch call per node per wave.
+    # False reverts to the per-send scalar egress path (one
+    # sign_wire_many per post, one coin issue batch per node per
+    # drain) — kept as the live byte-equivalence comparison arm
+    # (seeded runs must commit byte-identical ledgers under either
+    # arm; tests/test_egress_equivalence.py).
+    egress_columnar: bool = True
     # Bounded ordered-but-unsettled window: the ordered frontier may
     # run at most this many epochs ahead of the settled frontier
     # before ordering parks (backpressure).  A Byzantine coalition

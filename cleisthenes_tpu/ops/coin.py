@@ -21,7 +21,7 @@ ops/modmath.
 from __future__ import annotations
 
 import hashlib
-from typing import List, Sequence
+from typing import List, Optional, Sequence, Tuple
 
 from cleisthenes_tpu.ops import tpke
 from cleisthenes_tpu.ops.modmath import DEFAULT_GROUP, GroupParams
@@ -29,6 +29,8 @@ from cleisthenes_tpu.ops.tpke import (
     DhShare,
     ThresholdPublicKey,
     ThresholdSecretShare,
+    issue_shares_batch,
+    verify_share_groups,
 )
 
 
@@ -37,6 +39,35 @@ def coin_base(
 ) -> int:
     """The group element x = H2G(coin_id) whose s-th power is the coin."""
     return tpke.hash_to_group(b"coin|" + coin_id, group)
+
+
+def share_batch(
+    items: Sequence[tuple],
+    group: GroupParams = DEFAULT_GROUP,
+    backend: str = "cpu",
+    mesh=None,
+) -> List[DhShare]:
+    """Issue MANY coin shares — across instances, rounds, and (in an
+    in-proc cluster) issuers — in ONE vectorized multi-exponentiation
+    dispatch with ONE CP-nonce entropy draw (the wave-column treatment
+    ``Tpke.dec_share_batch`` already gave the TPKE side; Thetacrypt's
+    batched threshold-service shape, PAPERS.md 2502.03247).
+
+    ``items``: sequence of ``(secret, base, context, vk)`` exactly as
+    ``tpke.issue_shares_batch`` takes them — ``base``/``context`` come
+    from ``CommonCoin.group_params(coin_id)``, ``vk`` is the issuer's
+    verification key (None recomputes it in the same dispatch).
+    Semantics match mapping ``tpke.issue_share`` over the items;
+    result order matches input order.  The CryptoHub's coin-issue
+    column (``take_coin_issues``) dispatches through here; the scalar
+    comparison arm (``HoneyBadger._drain_coin_issues``) and the
+    lockstep spmd plane call ``tpke.issue_shares_batch`` directly —
+    the ``coin_share_batches`` counter is the hub's own tally,
+    incremented at BOTH the hub dispatch and the scalar drain, not a
+    call count of this function."""
+    return issue_shares_batch(
+        items, group=group, backend=backend, mesh=mesh
+    )
 
 
 class CommonCoin:
@@ -60,6 +91,32 @@ class CommonCoin:
             self.group,
         )
 
+    def share_batch(
+        self,
+        secret: ThresholdSecretShare,
+        coin_ids: Sequence[bytes],
+        vk: Optional[int] = None,
+    ) -> List[DhShare]:
+        """One issuer's coin shares for MANY coins — every (instance,
+        round) a wave touched — in one vectorized dispatch and one
+        CP-nonce draw.  Semantically ``[share(secret, cid) for cid in
+        coin_ids]``; ``vk`` (the issuer's verification key
+        g^{s_i}) defaults to the key set's own, saving one
+        exponentiation per item."""
+        if not coin_ids:
+            return []
+        if vk is None:
+            vk = self.pub.verification_keys[secret.index - 1]
+        return share_batch(
+            [
+                (secret, coin_base(cid, self.group), b"coin|" + cid, vk)
+                for cid in coin_ids
+            ],
+            group=self.group,
+            backend=self.backend,
+            mesh=self.mesh,
+        )
+
     def verify_shares(
         self, coin_id: bytes, shares: Sequence[DhShare]
     ) -> List[bool]:
@@ -68,6 +125,33 @@ class CommonCoin:
             coin_base(coin_id, self.group),
             shares,
             b"coin|" + coin_id,
+            self.backend,
+            self.mesh,
+        )
+
+    def verify_shares_batch(
+        self, entries: Sequence[Tuple[bytes, Sequence[DhShare]]]
+    ) -> List[List[bool]]:
+        """CP-verify MANY coins' pooled shares — across all BBA
+        instances and rounds a wave touched — in ONE
+        dual-exponentiation dispatch (semantically
+        ``[verify_shares(cid, shs) for cid, shs in entries]``; result
+        order matches input order).  The protocol hub reaches the same
+        dispatch shape by folding coin groups into its share column
+        (tpke.verify_share_groups); this is the coin-only entry point
+        for callers without a hub (lockstep executor, tests)."""
+        if not entries:
+            return []
+        return verify_share_groups(
+            [
+                (
+                    self.pub,
+                    coin_base(cid, self.group),
+                    shs,
+                    b"coin|" + cid,
+                )
+                for cid, shs in entries
+            ],
             self.backend,
             self.mesh,
         )
@@ -96,4 +180,4 @@ class CommonCoin:
         return bool(self.combine(coin_id, shares) & 1)
 
 
-__all__ = ["CommonCoin", "coin_base"]
+__all__ = ["CommonCoin", "coin_base", "share_batch"]

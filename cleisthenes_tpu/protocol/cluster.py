@@ -119,6 +119,7 @@ class SimulatedCluster:
             seed=seed,
             delivery_columnar=self.config.delivery_columnar,
             wave_routing=self.config.wave_routing,
+            egress_columnar=self.config.egress_columnar,
         )
         # dedup=True: the shared hub verifies each distinct pure crypto
         # check ONCE for the whole roster (see CryptoHub docstring) —

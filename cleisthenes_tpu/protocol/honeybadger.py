@@ -516,6 +516,15 @@ class HoneyBadger:
         self.committed_batches: List[Batch] = []
         self.on_commit: Optional[Callable[[int, Batch], None]] = None
         self.metrics = Metrics()
+        # coin-issue dispatch tallies -> snapshot()["hub"] (a shared
+        # hub reports cluster-wide numbers, like hub_dispatches; the
+        # counters move on BOTH egress arms — see _drain_coin_issues)
+        self.metrics.set_hub_stats(
+            lambda: {
+                "coin_share_batches": self.hub.coin_issue_batches,
+                "coin_share_items": self.hub.coin_issue_items,
+            }
+        )
         self.log = NodeLogger(node_id, "hb")
         # flight recorder (utils/trace.py): None when Config.trace is
         # off — every instrumentation site below guards on that, so
@@ -535,7 +544,10 @@ class HoneyBadger:
         # transport that calls transport_manages_idle() moves flushing
         # to its quiescence point for whole-wave bundles.
         self._coalesce = CoalescingBroadcaster(
-            out, self.members, trace=self.trace
+            out,
+            self.members,
+            trace=self.trace,
+            egress_columnar=config.egress_columnar,
         )
         self._transport_managed = False
         # semantic-adversary seam (protocol.byzantine): when a behavior
@@ -1151,8 +1163,28 @@ class HoneyBadger:
         turn-exit / idle drain issues every parked share in ONE
         batched exponentiation dispatch instead of 4 scalar host exps
         per instance (a vote wave triggers a whole roster's worth of
-        aux quorums at once)."""
+        aux quorums at once).  Under ``Config.egress_columnar`` the
+        want ALSO stages into the CryptoHub's coin-issue column at
+        queue time — during the message wave — so the idle phase's
+        FIRST drain executes the whole roster's wants (shared-hub
+        cluster) in one ``ops.coin.share_batch`` dispatch and later
+        drains claim precomputed shares."""
         self._pending_coin_issues.append((bba, rnd))
+        if self.config.egress_columnar:
+            # per-instance key material: a wave can span an activation
+            # boundary (dynamic membership), so each BBA issues under
+            # ITS epoch's coin key/share — the group is deployment-
+            # wide, so the whole mixed pool still batches into one
+            # dispatch
+            pub, base, context = bba.coin.group_params(bba._coin_id(rnd))
+            sec = bba.coin_secret
+            self.hub.stage_coin_issue(
+                self,
+                (bba, rnd),
+                (sec, base, context,
+                 pub.verification_keys[sec.index - 1]),
+                self.group,
+            )
 
     def _drain_coin_issues(self) -> None:
         pend = self._pending_coin_issues
@@ -1161,6 +1193,17 @@ class HoneyBadger:
         tr = self.trace
         t0 = 0.0 if tr is None else tr.now()
         self._pending_coin_issues = []
+        if self.config.egress_columnar:
+            # wave-batched coin kernel (ISSUE 13): the hub's coin
+            # column hands back this node's shares, dispatching the
+            # WHOLE staged pool natively iff some of ours are still
+            # pending — broadcast site, order, and timing identical
+            # to the scalar arm below
+            for (bba, rnd), share in self.hub.take_coin_issues(self):
+                bba.broadcast_coin_share(rnd, share)
+            if tr is not None:
+                tr.complete("coin", "issue_batch", t0, n=len(pend))
+            return
         # per-instance key material: a wave can span an activation
         # boundary (dynamic membership), so each BBA issues under ITS
         # epoch's coin key/share — the group is deployment-wide, so
@@ -1182,6 +1225,11 @@ class HoneyBadger:
             metas.append((bba, rnd))
         if not items:
             return
+        # the scalar comparison arm counts its native dispatches on
+        # the same hub counters the columnar arm uses, so
+        # coin_dispatches_per_epoch compares like for like across arms
+        self.hub.coin_issue_batches += 1
+        self.hub.coin_issue_items += len(items)
         shares = issue_shares_batch(
             items,
             group=group,

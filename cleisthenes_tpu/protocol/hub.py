@@ -75,6 +75,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from cleisthenes_tpu.ops.backend import BatchCrypto
+from cleisthenes_tpu.ops.coin import share_batch as coin_share_batch
 from cleisthenes_tpu.ops.tpke import verify_share_groups
 from cleisthenes_tpu.utils.memo import BoundedFifoMemo
 
@@ -261,6 +262,28 @@ class CryptoHub:
         self.decode_items = 0
         self.share_items = 0
         self.dispatches = 0
+        # Wave-batched coin-issue column (Config.egress_columnar,
+        # ISSUE 13): owners park (secret, base, context, vk) issue
+        # items at aux-quorum time (stage_coin_issue) and collect the
+        # shares at their own drain point (take_coin_issues).  The
+        # FIRST taker of a wave executes EVERY staged owner's pending
+        # items in one ops.coin.share_batch dispatch — one native
+        # multi-exponentiation and one CP-nonce draw for all BBA
+        # instances and rounds the wave touched, across ALL nodes of
+        # a shared-hub cluster — and parks each owner's shares until
+        # its drain claims them, so broadcast order and timing stay
+        # byte-identical to the scalar arm (one issue batch per node
+        # per drain).  Counter semantics: coin_issue_batches counts
+        # native coin-issue dispatches on BOTH arms (the scalar drain
+        # increments it too), the number bench.py reports as
+        # coin_dispatches_per_epoch and perfgate gates.
+        self.coin_issue_batches = 0
+        self.coin_issue_items = 0
+        self._coin_pool: List[Tuple] = []  # (owner, meta, item, group)
+        # owner -> [(meta, share)] awaiting the owner's drain.  A
+        # restarted owner object abandons its parked rows (one stale
+        # entry per crash — bounded by the run's restart count).
+        self._coin_results: Dict[object, List[Tuple]] = {}
         # per-flush total column width (branch+decode+share items) of
         # every flush that carried work, for the bench's
         # wave_width_p50/p95 counters (bounded; see WAVE_WIDTH_CAP)
@@ -638,6 +661,63 @@ class CryptoHub:
         for (item, keys) in zip(items, item_keys):
             item[5](item[3], [local[k] for k in keys])
 
+    # -- coin-issue column (Config.egress_columnar) ------------------------
+
+    def stage_coin_issue(self, owner, meta, item, group) -> None:
+        """Park one coin-share issue want: ``item`` is the
+        ``(secret, base, context, vk)`` tuple ``ops.coin.share_batch``
+        takes, ``meta`` the owner's own handle (returned with the
+        share), ``group`` the issue's GroupParams.  Staging happens at
+        aux-quorum time — during the message wave — so by the first
+        drain of the idle phase the whole roster's wants are pooled."""
+        self._coin_pool.append((owner, meta, item, group))
+
+    def take_coin_issues(self, owner) -> List[Tuple]:
+        """``(meta, share)`` rows for ``owner``, in stage order.  If
+        any of the owner's staged items are still pending, the WHOLE
+        pool — every staged owner — executes first in one native
+        dispatch per distinct group (one group in practice: the coin
+        group is deployment-wide), so a wave's coin issues across all
+        instances, rounds, and in-proc nodes cost one
+        multi-exponentiation and one CP-nonce draw."""
+        if any(row[0] is owner for row in self._coin_pool):
+            self._run_coin_pool()
+        return self._coin_results.pop(owner, [])
+
+    def _run_coin_pool(self) -> None:
+        pool, self._coin_pool = self._coin_pool, []
+        # insertion-ordered grouping by group object (DET002: the
+        # dispatch and result order must not depend on hash order)
+        groups: Dict[int, List[Tuple]] = {}
+        group_objs: Dict[int, object] = {}
+        for row in pool:
+            gid = id(row[3])
+            groups.setdefault(gid, []).append(row)
+            group_objs[gid] = row[3]
+        tr = self.trace
+        for gid, rows in groups.items():
+            t0 = 0.0 if tr is None else tr.now()
+            self.coin_issue_batches += 1
+            self.coin_issue_items += len(rows)
+            shares = coin_share_batch(
+                [row[2] for row in rows],
+                group=group_objs[gid],
+                backend=self.crypto.engine_backend,
+                mesh=self.crypto.mesh,
+            )
+            if tr is not None:
+                tr.complete(
+                    "coin",
+                    "share_batch",
+                    t0,
+                    n=len(rows),
+                    owners=len({id(row[0]) for row in rows}),
+                )
+            for row, share in zip(rows, shares):
+                self._coin_results.setdefault(row[0], []).append(
+                    (row[1], share)
+                )
+
     # -- stats -------------------------------------------------------------
 
     def stats(self) -> Dict[str, int]:
@@ -647,6 +727,8 @@ class CryptoHub:
             "branch_items": self.branch_items,
             "decode_items": self.decode_items,
             "share_items": self.share_items,
+            "coin_issue_batches": self.coin_issue_batches,
+            "coin_issue_items": self.coin_issue_items,
         }
 
 

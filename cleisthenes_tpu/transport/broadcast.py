@@ -15,7 +15,15 @@ deterministic scheduler in charge of *all* message interleavings.
 from __future__ import annotations
 
 import time
-from typing import Dict, List, Protocol, Sequence, runtime_checkable
+from typing import (
+    Dict,
+    List,
+    Optional,
+    Protocol,
+    Sequence,
+    Tuple,
+    runtime_checkable,
+)
 
 from cleisthenes_tpu.transport.message import (
     BbaBatchPayload,
@@ -71,6 +79,22 @@ class ChannelBroadcaster:
 
     def send_to(self, member_id: str, payload: Payload) -> None:
         self._network.post(self._node_id, member_id, self._wrap(payload))
+
+    def post_wave(self, entries) -> None:
+        """One egress wave (Config.egress_columnar): ``entries`` are
+        ``(member_id | None, payload)`` pairs — None addresses the full
+        broadcast set.  The whole wave crosses into the network in ONE
+        call, where the sender endpoint's ``sign_wire_wave`` encodes
+        each distinct body once and MACs the wave in one batched
+        pass."""
+        wave = [
+            (
+                self._members if member_id is None else (member_id,),
+                self._wrap(payload),
+            )
+            for member_id, payload in entries
+        ]
+        self._network.post_wave(self._node_id, wave)
 
 
 def _columnarize(buf: List[Payload]) -> List[Payload]:
@@ -190,9 +214,26 @@ class CoalescingBroadcaster:
     transport.base.Authenticator.sign_wire_many).
     """
 
-    def __init__(self, inner, member_ids: Sequence[str], trace=None) -> None:
+    def __init__(
+        self,
+        inner,
+        member_ids: Sequence[str],
+        trace=None,
+        egress_columnar: bool = False,
+    ) -> None:
         self._inner = inner
         self._members: List[str] = sorted(member_ids)
+        # Config.egress_columnar: hand each flush's whole wave of
+        # folded bundles to the inner broadcaster in ONE post_wave
+        # call — the transport signs it through one
+        # Authenticator.sign_wire_wave pass (shared-prefix
+        # FrameEncodeMemo, batched MACs) and writes one frame per peer
+        # per flush.  Falls back to the scalar per-post path when the
+        # inner broadcaster has no wave entry point (bare test
+        # broadcasters).
+        self._egress_wave = (
+            egress_columnar and getattr(inner, "post_wave", None) is not None
+        )
         # Broadcast payloads buffer ONCE on a shared list (a wave is
         # ~50k broadcasts at N=64; appending each to N per-receiver
         # buffers was ~1 s of epoch wall).  send_to payloads park per
@@ -299,7 +340,14 @@ class CoalescingBroadcaster:
             shared = self._shared
             if shared:
                 try:
-                    self._inner.broadcast(self._fold(shared))
+                    folded = self._fold(shared)
+                    if self._egress_wave:
+                        # whole wave in ONE transport call: the wave
+                        # signer encodes the envelope once and MACs
+                        # all receivers in one batched pass
+                        self._inner.post_wave([(None, folded)])
+                    else:
+                        self._inner.broadcast(folded)
                 except Exception:
                     self._dirty = True
                     self._broadcast_only = broadcast_only
@@ -307,20 +355,15 @@ class CoalescingBroadcaster:
                 self._shared = []
                 self.bundles_flushed += len(self._members)
             return
+        if self._egress_wave:
+            self._flush_mixed_wave()
+            return
         # mixed wave (rare: VAL fan-outs, CATCHUP serves): materialize
         # every receiver's merged view FIRST, then post — a transport
         # failure mid-loop must leave unsent members' payloads
         # buffered for the retry, already merged (anchor 0: they
         # precede anything buffered later)
-        shared, self._shared = self._shared, []
-        merged: Dict[str, List[Payload]] = {}
-        for m in self._members:
-            extras = self._extras[m]
-            if extras:
-                self._extras[m] = []
-                merged[m] = self._merged(shared, extras)
-            elif shared:
-                merged[m] = shared  # never mutated below
+        shared, merged = self._merged_views()
         for mi, m in enumerate(self._members):
             buf = merged.get(m)
             if not buf:
@@ -336,6 +379,63 @@ class CoalescingBroadcaster:
                 self._broadcast_only = False
                 raise
             self.bundles_flushed += 1
+
+    def _merged_views(
+        self,
+    ) -> Tuple[List[Payload], Dict[str, List[Payload]]]:
+        """Pop the wave's buffers into every receiver's arrival-order
+        merged view (shared between the scalar mixed path and the
+        columnar wave path, so the two byte-equivalence arms cannot
+        diverge here).  Receivers with no extras ALIAS the shared
+        list — never mutated downstream; the columnar path keys on
+        that identity to fold it once."""
+        shared, self._shared = self._shared, []
+        merged: Dict[str, List[Payload]] = {}
+        for m in self._members:
+            extras = self._extras[m]
+            if extras:
+                self._extras[m] = []
+                merged[m] = self._merged(shared, extras)
+            elif shared:
+                merged[m] = shared  # never mutated below
+        return shared, merged
+
+    def _flush_mixed_wave(self) -> None:
+        """Mixed-wave columnar flush (Config.egress_columnar): every
+        receiver's merged bundle ships in ONE ``post_wave`` call.
+        Receivers whose bundle is exactly the shared broadcast run
+        share one folded payload OBJECT, so the transport's
+        FrameEncodeMemo collapses their envelope bodies to a single
+        encode; per-receiver merges (VAL fan-outs, CATCHUP serves,
+        injected per-receiver lies) fold individually but still share
+        their sub-payload objects with the run.  A transport failure
+        re-parks every receiver's merged view for the retry, exactly
+        like the scalar mixed path."""
+        shared, merged = self._merged_views()
+        entries: List[tuple] = []
+        shared_fold: Optional[Payload] = None
+        for m in self._members:
+            buf = merged.get(m)
+            if not buf:
+                continue
+            if buf is shared:
+                if shared_fold is None:
+                    shared_fold = self._fold(shared)
+                entries.append((m, shared_fold))
+            else:
+                entries.append((m, self._fold(buf)))
+        if not entries:
+            return
+        try:
+            self._inner.post_wave(entries)
+        except Exception:
+            for m, buf in merged.items():
+                if buf:
+                    self._extras[m] = [(0, p) for p in buf]
+            self._dirty = True
+            self._broadcast_only = False
+            raise
+        self.bundles_flushed += len(entries)
 
 
 __all__ = ["PayloadBroadcaster", "ChannelBroadcaster", "CoalescingBroadcaster"]

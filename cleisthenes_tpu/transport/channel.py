@@ -29,13 +29,16 @@ from cleisthenes_tpu.transport.base import (
     Authenticator,
     Handler,
     NullAuthenticator,
+    sign_wave_counted,
 )
 from cleisthenes_tpu.transport.message import (
     FrameDecodeMemo,
+    FrameEncodeMemo,
     Message,
     decode_frame,
     decode_frame_shared,
     encode_message,
+    payload_body_count,
 )
 
 # A fault filter sees (sender_id, receiver_id, wire_bytes) and returns
@@ -51,7 +54,11 @@ class ChannelEndpoint:
     authenticator (signing outbound, verifying inbound)."""
 
     def __init__(
-        self, node_id: str, handler: Handler, auth: Authenticator
+        self,
+        node_id: str,
+        handler: Handler,
+        auth: Authenticator,
+        encode_memo: Optional[FrameEncodeMemo] = None,
     ) -> None:
         self.node_id = node_id
         self.auth = auth
@@ -66,6 +73,16 @@ class ChannelEndpoint:
         self.decode_memo_hits = 0
         self.decode_memo_misses = 0
         self.mac_verify_batches = 0
+        # egress-plane counters (Config.egress_columnar, the send-side
+        # twins): payload bodies actually encoded / shared-prefix
+        # encode-memo hits+misses / Authenticator sign invocations
+        # (one per post scalar, one per wave columnar).  The memo is
+        # THIS node's outbound encode memo (None on the scalar arm).
+        self.frames_encoded = 0
+        self.encode_memo_hits = 0
+        self.encode_memo_misses = 0
+        self.mac_sign_batches = 0
+        self.encode_memo = encode_memo
         self.bind(handler)
 
     def bind(self, handler: Handler) -> None:
@@ -128,6 +145,7 @@ class ChannelNetwork:
         queue_capacity: int = 1_000_000,
         delivery_columnar: bool = False,
         wave_routing: bool = False,
+        egress_columnar: bool = False,
     ):
         # seed=None -> FIFO delivery; seed=int -> seeded random-order
         # delivery (the adversarial asynchronous scheduler from
@@ -169,6 +187,24 @@ class ChannelNetwork:
         # live on ChannelEndpoint for Metrics.snapshot)
         self.frames_decoded = 0
         self.mac_verify_calls = 0
+        # Columnar egress plane (Config.egress_columnar): each flush's
+        # whole wave of folded bundles arrives in ONE post_wave call,
+        # signs through the sender endpoint's sign_wire_wave (payload
+        # bodies encode once per distinct object via the per-endpoint
+        # FrameEncodeMemo, MACs in one batched pass) and enqueues one
+        # frame per peer per flush.  The scalar per-post path stays
+        # byte-equivalent (tests/test_egress_equivalence.py).
+        self._egress_columnar = egress_columnar
+        # network-wide egress counters (the send-side twins of the
+        # delivery counters above)
+        self.frames_encoded = 0
+        self.mac_sign_calls = 0
+        # test hook (tests/test_egress_equivalence.py): when set,
+        # called (sender_id, receiver_id, wire bytes) for every frame
+        # at enqueue time — the frame-stream capture the egress
+        # byte-equivalence proof compares across arms.  None in all
+        # non-test use.
+        self.frame_tap: Optional[Callable[[str, str, bytes], None]] = None
 
     # -- topology ----------------------------------------------------------
 
@@ -179,7 +215,12 @@ class ChannelNetwork:
         auth: Optional[Authenticator] = None,
     ) -> None:
         self._endpoints[node_id] = ChannelEndpoint(
-            node_id, handler, auth or NullAuthenticator()
+            node_id,
+            handler,
+            auth or NullAuthenticator(),
+            encode_memo=(
+                FrameEncodeMemo() if self._egress_columnar else None
+            ),
         )
 
     def rebind_handler(self, node_id: str, handler: Handler) -> None:
@@ -204,6 +245,10 @@ class ChannelNetwork:
             "decode_memo_hits": ep.decode_memo_hits,
             "decode_memo_misses": ep.decode_memo_misses,
             "mac_verify_batches": ep.mac_verify_batches,
+            "frames_encoded": ep.frames_encoded,
+            "encode_memo_hits": ep.encode_memo_hits,
+            "encode_memo_misses": ep.encode_memo_misses,
+            "mac_sign_batches": ep.mac_sign_batches,
         }
 
     def delivery_stats(self) -> Dict[str, int]:
@@ -213,11 +258,24 @@ class ChannelNetwork:
         tallies — the numbers bench.py's protocol sections and
         tools/perfgate.py gate on."""
         memo = self._decode_memo
+        ehits = emisses = 0
+        for ep in self._endpoints.values():
+            em = ep.encode_memo
+            if em is not None:
+                ehits += em.hits
+                emisses += em.misses
         return {
             "frames_decoded": self.frames_decoded,
             "mac_verifies": self.mac_verify_calls,
             "decode_memo_hits": 0 if memo is None else memo.hits,
             "decode_memo_misses": 0 if memo is None else memo.misses,
+            # egress twins (Config.egress_columnar): payload bodies
+            # actually encoded, Authenticator sign invocations, and
+            # the per-endpoint encode memos' pooled hit/miss tallies
+            "frames_encoded": self.frames_encoded,
+            "mac_signs": self.mac_sign_calls,
+            "encode_memo_hits": ehits,
+            "encode_memo_misses": emisses,
         }
 
     def link_states(self, node_id: str) -> Dict[str, str]:
@@ -294,21 +352,41 @@ class ChannelNetwork:
 
     # -- message flow ------------------------------------------------------
 
+    def _enqueue(self, sender_id: str, receiver_id: str, wire: bytes) -> None:
+        self.messages_posted += 1
+        self.bytes_posted += len(wire)
+        if self.frame_tap is not None:
+            self.frame_tap(sender_id, receiver_id, wire)
+        self._pending.append([sender_id, receiver_id, wire, False, None])
+        self._unprepared += 1
+
     def post(self, sender_id: str, receiver_id: str, msg: Message) -> None:
         """Sign, encode and enqueue one message."""
         if sender_id in self._crashed:
             return
+        ep = self._endpoints.get(sender_id)
+        if ep is not None and self._egress_columnar:
+            # single-receiver sends take the SAME wave signer as flush
+            # waves (ISSUE 13 satellite): a mid-wave re-send of a
+            # payload object the encode memo already holds reuses its
+            # encoded body instead of re-encoding the envelope
+            self.post_wave(sender_id, (((receiver_id,), msg),))
+            return
         if len(self._pending) >= self._queue_capacity:
             raise OverflowError("channel network queue full")
-        ep = self._endpoints.get(sender_id)
         if ep is None:
-            wire = encode_message(msg)
+            wire = encode_message(msg)  # staticcheck: allow[DET006] non-endpoint test rig
         else:  # sign_wire_many encodes the envelope exactly once
-            wire = ep.auth.sign_wire_many(msg, [receiver_id])[receiver_id]
-        self.messages_posted += 1
-        self.bytes_posted += len(wire)
-        self._pending.append([sender_id, receiver_id, wire, False, None])
-        self._unprepared += 1
+            bodies = payload_body_count(msg.payload)
+            ep.frames_encoded += bodies
+            ep.mac_sign_batches += 1
+            self.frames_encoded += bodies
+            self.mac_sign_calls += 1
+            frames = ep.auth.sign_wire_many(  # staticcheck: allow[DET006] scalar arm
+                msg, [receiver_id]
+            )
+            wire = frames[receiver_id]
+        self._enqueue(sender_id, receiver_id, wire)
 
     def post_many(
         self, sender_id: str, receiver_ids, msg: Message
@@ -323,14 +401,73 @@ class ChannelNetwork:
             for rid in receiver_ids:
                 self.post(sender_id, rid, msg)
             return
-        frames = ep.auth.sign_wire_many(msg, receiver_ids)
+        if self._egress_columnar:
+            self.post_wave(sender_id, ((tuple(receiver_ids), msg),))
+            return
+        bodies = payload_body_count(msg.payload)
+        ep.frames_encoded += bodies
+        ep.mac_sign_batches += 1
+        self.frames_encoded += bodies
+        self.mac_sign_calls += 1
+        frames = ep.auth.sign_wire_many(  # staticcheck: allow[DET006] scalar arm
+            msg, receiver_ids
+        )
         for rid, wire in frames.items():
             if len(self._pending) >= self._queue_capacity:
                 raise OverflowError("channel network queue full")
-            self.messages_posted += 1
-            self.bytes_posted += len(wire)
-            self._pending.append([sender_id, rid, wire, False, None])
-            self._unprepared += 1
+            self._enqueue(sender_id, rid, wire)
+
+    def post_wave(self, sender_id: str, entries) -> None:
+        """One egress wave (Config.egress_columnar): ``entries`` are
+        ``(receiver_ids, msg)`` pairs — everything one coalescer flush
+        ships.  The whole wave signs through the sender endpoint's
+        ``Authenticator.sign_wire_wave`` (payload bodies encode once
+        per distinct object via the per-endpoint FrameEncodeMemo, MACs
+        in one batched pass over the precomputed pair-key schedules)
+        and enqueues in one pass — one frame per peer per flush, since
+        the coalescer already folded each receiver's wave into a
+        single bundle.  Admission is atomic: the wave is rejected
+        whole when it would overflow the queue, so a coalescer retry
+        never double-posts a partially shipped wave."""
+        if sender_id in self._crashed:
+            return
+        ep = self._endpoints.get(sender_id)
+        if ep is None:
+            for rids, msg in entries:
+                for rid in rids:
+                    self.post(sender_id, rid, msg)
+            return
+        need = sum(len(rids) for rids, _msg in entries)
+        if len(self._pending) + need > self._queue_capacity:
+            raise OverflowError("channel network queue full")
+        tr = getattr(ep.handler, "trace", None)
+        t0 = 0.0 if tr is None else tr.now()
+        frames_list, hits, misses, bodies = sign_wave_counted(
+            ep.auth,
+            [(msg, rids) for rids, msg in entries],
+            ep.encode_memo,
+        )
+        ep.mac_sign_batches += 1
+        self.mac_sign_calls += 1
+        ep.encode_memo_hits += hits
+        ep.encode_memo_misses += misses
+        ep.frames_encoded += bodies
+        self.frames_encoded += bodies
+        if tr is not None:
+            # ONE span per egress wave (mirror of the ingest
+            # frame_decode span): args carry the wave's bundle count
+            # and the encode memo's hit tally, tools/tracetool.py
+            # rolls them into the delivery summary
+            tr.complete(
+                "transport",
+                "frame_encode",
+                t0,
+                frames=len(entries),
+                memo_hits=hits,
+            )
+        for (rids, _msg), frames in zip(entries, frames_list):
+            for rid in rids:
+                self._enqueue(sender_id, rid, frames[rid])
 
     def pending_count(self) -> int:
         return len(self._pending)

@@ -113,7 +113,8 @@ class GrpcConnection:
             # the pool addresses client connections by roster member id
             # (host.py DialOpts conn_id=member), so conn_id names the
             # receiver for the pairwise MAC
-            wire = encode_message(self._auth.sign(msg, self._conn_id))
+            signed = self._auth.sign(msg, self._conn_id)
+            wire = encode_message(signed)  # staticcheck: allow[DET006] scalar arm / pre-pool path
         except Exception as exc:
             if on_err is not None:
                 on_err(exc)

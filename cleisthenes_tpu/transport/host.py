@@ -30,6 +30,7 @@ from cleisthenes_tpu.protocol.honeybadger import HoneyBadger, NodeKeys
 from cleisthenes_tpu.transport.base import (
     ConnectionPool,
     HmacAuthenticator,
+    sign_wave_counted,
 )
 from cleisthenes_tpu.transport.grpc_net import (
     DialOpts,
@@ -42,7 +43,12 @@ from cleisthenes_tpu.transport.health import (
     PeerHealthTracker,
     backoff_rng,
 )
-from cleisthenes_tpu.transport.message import Message, Payload
+from cleisthenes_tpu.transport.message import (
+    FrameEncodeMemo,
+    Message,
+    Payload,
+    payload_body_count,
+)
 from cleisthenes_tpu.utils.determinism import guarded_by
 from cleisthenes_tpu.utils.log import NodeLogger
 
@@ -205,6 +211,7 @@ class GrpcPayloadBroadcaster:
         pool: ConnectionPool,
         local: SerialDispatcher,
         auth,
+        egress_columnar: bool = False,
     ) -> None:
         self._node_id = node_id
         self._pool = pool
@@ -216,6 +223,22 @@ class GrpcPayloadBroadcaster:
         self._ready = False
         self._pending: List = []
         self._lock = threading.Lock()
+        # Columnar egress (Config.egress_columnar): the coalescer
+        # hands each flush's whole wave to post_wave, which signs it
+        # in ONE Authenticator.sign_wire_wave pass (payload bodies
+        # encode once per distinct object via the encode memo, MACs
+        # batched over the precomputed pair schedules) and makes one
+        # stream write per peer per flush (the wave already folds to
+        # one bundle per receiver).  Counters are the egress twins of
+        # the connection-side delivery counters, folded into
+        # Metrics.snapshot()["transport"] by the host.
+        self._encode_memo = (
+            FrameEncodeMemo() if egress_columnar else None
+        )
+        self.frames_encoded = 0
+        self.encode_memo_hits = 0
+        self.encode_memo_misses = 0
+        self.mac_sign_batches = 0
 
     def mark_ready(self) -> None:
         with self._lock:
@@ -231,6 +254,8 @@ class GrpcPayloadBroadcaster:
 
     def _deliver(self, member_id: Optional[str], msg: Message) -> None:
         """member_id None = broadcast to all peers."""
+        self.frames_encoded += payload_body_count(msg.payload)
+        self.mac_sign_batches += 1
         if member_id is None:
             # pairwise MACs: each peer gets its own signed frame (one
             # key per peer — the sign-once/fan-out-identical-bytes path
@@ -238,13 +263,82 @@ class GrpcPayloadBroadcaster:
             # design ADVICE.md retired).  The envelope is encoded once;
             # only the 32-byte MAC differs per frame.
             conns = self._pool.get_all()
-            frames = self._auth.sign_wire_many(
+            frames = self._auth.sign_wire_many(  # staticcheck: allow[DET006] scalar arm
                 msg, [c.id() for c in conns]
             )
             for conn in conns:
                 conn.send_wire(frames[conn.id()])
         else:
             self._pool.send_to(member_id, msg)
+
+    def post_wave(self, entries) -> None:
+        """One egress wave (Config.egress_columnar): ``entries`` are
+        ``(member_id | None, payload)`` pairs — one coalescer flush.
+        The whole wave signs in ONE ``sign_wire_wave`` pass and ships
+        as one stream write per peer per flush; local self-delivery
+        short-circuits through the dispatcher exactly like the scalar
+        arm, but only AFTER the fallible sign pass — a sign failure
+        re-parks the wave in the coalescer, and serving local first
+        would double-deliver the node's own payloads on the retry.
+        Before the dial pool completes, the WHOLE wave parks per
+        receiver in one pass and re-delivers scalar on mark_ready
+        (boot-time traffic is a handful of frames; parking all-or-
+        nothing keeps a mid-wave failure from re-parking entries the
+        pending list already holds)."""
+        msgs = [
+            (member_id, self._wrap(payload))
+            for member_id, payload in entries
+        ]
+        with self._lock:
+            ready = self._ready
+            if not ready:
+                for member_id, msg in msgs:
+                    if member_id != self._node_id:
+                        self._pending.append((member_id, msg))
+        if not ready:
+            # scalar parity: local delivery never waits on the pool
+            for member_id, msg in msgs:
+                if member_id is None or member_id == self._node_id:
+                    self._local.serve_request(msg)  # staticcheck: allow[DET004] self-delivery
+            return
+        wave: List = []  # (msg, receiver_ids, conns)
+        local: List[Message] = []
+        for member_id, msg in msgs:
+            if member_id is None:
+                conns = self._pool.get_all()
+                wave.append((msg, [c.id() for c in conns], conns))
+                local.append(msg)
+            elif member_id == self._node_id:
+                local.append(msg)
+            else:
+                conn = self._pool.get(member_id)
+                if conn is not None:
+                    wave.append((msg, [member_id], [conn]))
+        if wave:
+            tr = getattr(self._local, "trace", None)
+            t0 = 0.0 if tr is None else tr.now()
+            frames_list, hits, misses, bodies = sign_wave_counted(
+                self._auth,
+                [(msg, rids) for msg, rids, _conns in wave],
+                self._encode_memo,
+            )
+            self.mac_sign_batches += 1
+            self.encode_memo_hits += hits
+            self.encode_memo_misses += misses
+            self.frames_encoded += bodies
+            if tr is not None:
+                tr.complete(
+                    "transport",
+                    "frame_encode",
+                    t0,
+                    frames=len(wave),
+                    memo_hits=hits,
+                )
+            for (_msg, _rids, conns), frames in zip(wave, frames_list):
+                for conn in conns:
+                    conn.send_wire(frames[conn.id()])
+        for msg in local:
+            self._local.serve_request(msg)  # staticcheck: allow[DET004] local self-delivery
 
     def _post(self, member_id: Optional[str], msg: Message) -> None:
         with self._lock:
@@ -330,7 +424,11 @@ class ValidatorHost:
             p for p in self.members if p != node_id
         )
         self.out = GrpcPayloadBroadcaster(
-            node_id, self.pool, self.dispatcher, self._auth
+            node_id,
+            self.pool,
+            self.dispatcher,
+            self._auth,
+            egress_columnar=config.egress_columnar,
         )
         batch_log = None
         if batch_log_path is not None:
@@ -450,6 +548,13 @@ class ValidatorHost:
             "rejected": rejected,
             "frames_decoded": decoded,
             "mac_verify_batches": batches,
+            # egress twins (Config.egress_columnar): the payload
+            # broadcaster owns the outbound signer seam, so its
+            # counters are already host-cumulative
+            "frames_encoded": self.out.frames_encoded,
+            "encode_memo_hits": self.out.encode_memo_hits,
+            "encode_memo_misses": self.out.encode_memo_misses,
+            "mac_sign_batches": self.out.mac_sign_batches,
         }
 
     # -- lifecycle ---------------------------------------------------------

@@ -825,11 +825,90 @@ def signing_bytes(msg: Message) -> bytes:
     signature itself (the reference's intended-but-absent semantics,
     message.proto:14, conn.go:134-137)."""
     kind, body = _encode_payload(msg.payload)
+    return _assemble_signing(msg, kind, body)
+
+
+def _assemble_signing(msg: Message, kind: int, body: bytes) -> bytes:
     out: List[bytes] = [_MAGIC, struct.pack(">BB", _VERSION, kind)]
     _pack_str(out, msg.sender_id)
     out.append(struct.pack(">d", msg.timestamp))
     _pack_bytes(out, body)
     return b"".join(out)
+
+
+class FrameEncodeMemo(BoundedFifoMemo):
+    """Shared outbound payload-encode memo (Config.egress_columnar) —
+    the encode twin of ``FrameDecodeMemo``.
+
+    One egress wave's per-receiver frames are mostly re-encodings of
+    SHARED payload objects: a mixed flush folds the wave's broadcast
+    run into each receiver's bundle, so N receiver bundles carry the
+    same sub-payload objects and the scalar path re-encoded each of
+    them once per receiver.  Keying the encoded ``(kind, body)`` on
+    the payload OBJECT collapses those to one encode + N joins.
+
+    The decode memo keys on the wire prefix's SHA-256 digest because
+    the bytes already exist on arrival; on the send side the bytes are
+    the memo's PRODUCT, so the pre-encode name of the content is the
+    immutable payload object itself — entries pin the object (and hits
+    re-check identity), so id reuse after GC can never alias, the same
+    pin-the-inputs discipline as the hub's id-slot branch dedup.
+    Eviction is the shared BoundedFifoMemo FIFO discipline (oldest
+    insertion first, never clear-all).  ``hits``/``misses`` feed the
+    transport egress metrics (``encode_memo_hit_rate`` in the bench
+    sections); a miss is a payload body actually encoded — the
+    ``frames_encoded`` counter's unit on both egress arms."""
+
+    __slots__ = ("hits", "misses")
+
+    def __init__(self, cap: int = 4096):
+        super().__init__(cap)
+        self.hits = 0
+        self.misses = 0
+
+
+def encode_payload_shared(
+    p: Payload, memo: FrameEncodeMemo
+) -> Tuple[int, bytes]:
+    """(kind, body) for one NON-BUNDLE payload through the memo."""
+    key = id(p)
+    ent = memo.map.get(key)
+    if ent is not None and ent[0] is p:
+        memo.hits += 1
+        return ent[1], ent[2]
+    memo.misses += 1
+    kind, body = _encode_payload(p)
+    memo.put(key, (p, kind, body))
+    return kind, body
+
+
+def signing_bytes_shared(msg: Message, memo: FrameEncodeMemo) -> bytes:
+    """``signing_bytes`` through the FrameEncodeMemo — byte-identical
+    output (tests assert it), but a BundlePayload's sub-items and any
+    repeated top-level payload encode once per distinct OBJECT across
+    the wave instead of once per receiver frame."""
+    p = msg.payload
+    if isinstance(p, BundlePayload):
+        if len(p.items) > MAX_BUNDLE_ITEMS:
+            raise ValueError(f"bundle of {len(p.items)} items exceeds cap")
+        out: List[bytes] = [struct.pack(">I", len(p.items))]
+        for item in p.items:
+            kind, body = encode_payload_shared(item, memo)
+            if kind == _KIND_BUNDLE:
+                raise ValueError("nested bundles are not allowed")
+            out.append(struct.pack(">B", kind))
+            _pack_bytes(out, body)
+        return _assemble_signing(msg, _KIND_BUNDLE, b"".join(out))
+    kind, body = encode_payload_shared(p, memo)
+    return _assemble_signing(msg, kind, body)
+
+
+def payload_body_count(p: Payload) -> int:
+    """Payload bodies one envelope encode touches (bundle items, or
+    1): the ``frames_encoded`` counter's unit on the SCALAR egress arm
+    — the columnar arm counts FrameEncodeMemo misses, which probe per
+    body, so both arms tally the same work unit."""
+    return len(p.items) if isinstance(p, BundlePayload) else 1
 
 
 def attach_signature(signing: bytes, signature: bytes) -> bytes:
@@ -1039,6 +1118,10 @@ __all__ = [
     "decode_frame",
     "decode_frame_shared",
     "FrameDecodeMemo",
+    "FrameEncodeMemo",
+    "encode_payload_shared",
+    "payload_body_count",
     "signing_bytes",
+    "signing_bytes_shared",
     "MAX_FIELD_BYTES",
 ]

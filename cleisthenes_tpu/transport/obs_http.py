@@ -273,6 +273,56 @@ def render_prometheus(targets: Sequence[ObsTarget]) -> str:
             labels,
             int(transport["mac_verify_batches"]),
         )
+        # egress-columnarization counters (ISSUE 13; always present —
+        # zeroed on the scalar arm per the schema-stability rule)
+        exp.add(
+            exp.family(
+                "transport_frames_encoded_total", "counter",
+                "outbound payload bodies actually encoded "
+                "(shared-prefix encode memo hits skip the encode)",
+            ),
+            labels,
+            int(transport["frames_encoded"]),
+        )
+        ememo = exp.family(
+            "transport_encode_memo_total", "counter",
+            "shared-prefix frame-encode memo probes by result",
+        )
+        for result, key in (
+            ("hit", "encode_memo_hits"),
+            ("miss", "encode_memo_misses"),
+        ):
+            exp.add(
+                ememo, {**labels, "result": result}, int(transport[key])
+            )
+        exp.add(
+            exp.family(
+                "transport_mac_sign_batches_total", "counter",
+                "authenticator sign invocations (one per egress wave "
+                "columnar; one per post scalar)",
+            ),
+            labels,
+            int(transport["mac_sign_batches"]),
+        )
+        hub = snap["hub"]
+        exp.add(
+            exp.family(
+                "coin_share_batches_total", "counter",
+                "native coin-share issue dispatches (one per staged "
+                "pool per wave columnar; one per node per drain "
+                "scalar)",
+            ),
+            labels,
+            int(hub["coin_share_batches"]),
+        )
+        exp.add(
+            exp.family(
+                "coin_share_items_total", "counter",
+                "coin shares issued through the batched coin kernels",
+            ),
+            labels,
+            int(hub["coin_share_items"]),
+        )
         # wave-routed ingest counters (always present — zeroed on the
         # scalar routing arm per the schema-stability rule)
         router = snap["router"]

@@ -262,6 +262,11 @@ class Metrics:
         # .alerts_block, set by the host/cluster that owns the
         # watchdog): folds health + per-alert counters into snapshot()
         self._alerts: Optional[Callable[[], Dict]] = None
+        # crypto-hub counter provider (set by the owning HoneyBadger):
+        # folds the coin-issue dispatch tallies into snapshot()["hub"]
+        # (a cluster-SHARED hub reports cluster-wide numbers on every
+        # node, the same convention as bench.py's hub_dispatches)
+        self._hub_stats: Optional[Callable[[], Dict]] = None
         # frontier provider (set by the owning HoneyBadger): () ->
         # (ordered_frontier, settled_frontier).  decrypt_lag_epochs =
         # ordered - settled is THE two-frontier health signal — zero on
@@ -289,6 +294,11 @@ class Metrics:
 
     def set_alerts(self, provider: Optional[Callable[[], Dict]]) -> None:
         self._alerts = provider
+
+    def set_hub_stats(
+        self, provider: Optional[Callable[[], Dict]]
+    ) -> None:
+        self._hub_stats = provider
 
     def set_frontiers(
         self, provider: Optional[Callable[[], Tuple[int, int]]]
@@ -447,10 +457,27 @@ class Metrics:
             "decode_memo_hits": 0,
             "decode_memo_misses": 0,
             "mac_verify_batches": 0,
+            # egress-plane twins (Config.egress_columnar): same
+            # zeroed-key schema rule on both egress arms
+            "frames_encoded": 0,
+            "encode_memo_hits": 0,
+            "encode_memo_misses": 0,
+            "mac_sign_batches": 0,
         }
         if self._transport_stats is not None:
             transport.update(self._transport_stats())
         out["transport"] = transport
+        # crypto-hub block: ALWAYS present with every key, zeroed on
+        # bare nodes (the PR-9 schema-stability rule); the coin-issue
+        # dispatch tallies are counted on BOTH egress arms, so the
+        # scalar arm reports its per-node-per-drain batches here too
+        hub: Dict[str, object] = {
+            "coin_share_batches": 0,
+            "coin_share_items": 0,
+        }
+        if self._hub_stats is not None:
+            hub.update(self._hub_stats())
+        out["hub"] = hub
         if self._transport_health is not None:
             out["transport_health"] = self._transport_health()
         if self._trace_stats is not None:

@@ -1,0 +1,19 @@
+"""Known-bad DET006 fixture: a transport send loop encoding and
+signing per frame — the exact per-post envelope encode + MAC pass the
+wave signer (ISSUE 13) replaced.  Both the sign_wire_many form (one
+scalar signer pass per post) and a direct encode_message call (a raw
+per-frame envelope encode from a send path) must gate."""
+
+from cleisthenes_tpu.transport.message import encode_message
+
+
+def flush_outbound(auth, posts):
+    frames = []
+    for msg, receiver_id in posts:
+        wire = auth.sign_wire_many(msg, [receiver_id])  # BAD:DET006
+        frames.append(wire[receiver_id])
+    return frames
+
+
+def send_raw(conn, auth, msg, receiver_id):
+    conn.send_wire(encode_message(auth.sign(msg, receiver_id)))  # BAD:DET006

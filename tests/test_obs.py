@@ -109,6 +109,16 @@ def test_transport_block_uniform_on_bare_metrics():
         "decode_memo_hits": 0,
         "decode_memo_misses": 0,
         "mac_verify_batches": 0,
+        "frames_encoded": 0,
+        "encode_memo_hits": 0,
+        "encode_memo_misses": 0,
+        "mac_sign_batches": 0,
+    }
+    # egress-columnarization twin block (ISSUE 13): same zeroed-key
+    # schema rule for the coin-issue dispatch tallies
+    assert snap["hub"] == {
+        "coin_share_batches": 0,
+        "coin_share_items": 0,
     }
 
 
@@ -224,7 +234,15 @@ def _golden_target() -> ObsTarget:
             "decode_memo_hits": 4,
             "decode_memo_misses": 2,
             "mac_verify_batches": 3,
+            # egress-columnarization counters (ISSUE 13): same rule
+            "frames_encoded": 5,
+            "encode_memo_hits": 3,
+            "encode_memo_misses": 2,
+            "mac_sign_batches": 4,
         }
+    )
+    m.set_hub_stats(
+        lambda: {"coin_share_batches": 2, "coin_share_items": 9}
     )
     m.set_transport_health(
         lambda: {
@@ -445,6 +463,8 @@ def test_cluster_obs_endpoints_scrape():
             "delivered", "rejected", "dedup_absorbed",
             "frames_decoded", "decode_memo_hits",
             "decode_memo_misses", "mac_verify_batches",
+            "frames_encoded", "encode_memo_hits",
+            "encode_memo_misses", "mac_sign_batches",
         }
         assert node0["alerts"][EPOCH_STALL]["active"] is False
         status, _ = _get(base + "/nope")

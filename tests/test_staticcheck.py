@@ -82,6 +82,11 @@ BAD_FIXTURES = [
     # a fixed-roster read is correct right up until the first
     # RECONFIG crosses, then a silent fork
     "protocol/det005_bad.py",
+    # the egress wave-signer seam (ISSUE 13): per-frame envelope
+    # encode+sign from a transport send path still gates — the
+    # one-sign-pass-per-wave discipline can't silently erode back to
+    # one encode + MAC per post
+    "transport/det006_bad.py",
     "protocol/conc001_bad.py",
     "transport/conc002_bad.py",
     "protocol/err001_bad.py",
@@ -92,6 +97,7 @@ GOOD_FIXTURES = [
     "protocol/det003_good.py",
     "transport/det004_good.py",
     "protocol/det005_good.py",
+    "transport/det006_good.py",
     "protocol/conc001_good.py",
     "transport/conc002_good.py",
     "protocol/err001_good.py",
@@ -180,6 +186,7 @@ def test_rule_catalog_registered():
         "DET003",
         "DET004",
         "DET005",
+        "DET006",
         "CONC001",
         "CONC002",
         "ERR001",

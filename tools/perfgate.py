@@ -177,6 +177,19 @@ def append_bench_trend(result: Dict, path: str = str(DEFAULT_TREND)) -> int:
                     "handler_dispatches_per_epoch": side.get(
                         "handler_dispatches_per_epoch"
                     ),
+                    # egress columnarization (ISSUE 13)
+                    "frames_encoded_per_epoch": side.get(
+                        "frames_encoded_per_epoch"
+                    ),
+                    "mac_signs_per_epoch": side.get(
+                        "mac_signs_per_epoch"
+                    ),
+                    "encode_memo_hit_rate": side.get(
+                        "encode_memo_hit_rate"
+                    ),
+                    "coin_dispatches_per_epoch": side.get(
+                        "coin_dispatches_per_epoch"
+                    ),
                 }
                 append_record(path, record)
                 appended += 1
@@ -265,6 +278,11 @@ def run_sample(
             # — a mode flip must never gate against the other mode's
             # trend
             "wave_routing": bool(cfg.wave_routing),
+            # the egress arm changes what the encode/sign/coin
+            # counters MEAN (scalar: one sign pass per post, one coin
+            # batch per node per drain; columnar: one wave pass per
+            # flush, one pooled coin dispatch) — same rule
+            "egress_columnar": bool(cfg.egress_columnar),
         },
         "epoch_p50_ms": round(p50 * 1000.0, 3),
         "epoch_p95_ms": round(p95 * 1000.0, 3),
@@ -309,6 +327,26 @@ def run_sample(
                 hb.metrics.handler_dispatches.value
                 for hb in cluster.nodes.values()
             )
+        ),
+        # egress columnarization (ISSUE 13): outbound encode+sign
+        # passes and native coin-issue dispatches — deterministic for
+        # the seeded schedule, gated like the delivery counters (an
+        # egress regression — the memo stops sharing, waves stop
+        # folding, the coin pool stops batching — fails with zero
+        # noise)
+        "frames_encoded": int(dstats["frames_encoded"]),
+        "mac_signs": int(dstats["mac_signs"]),
+        "encode_memo_hit_rate": (
+            round(
+                dstats["encode_memo_hits"]
+                / (dstats["encode_memo_hits"] + dstats["encode_memo_misses"]),
+                4,
+            )
+            if (dstats["encode_memo_hits"] + dstats["encode_memo_misses"])
+            else 0.0
+        ),
+        "coin_dispatches": int(
+            cluster.nodes[ids[0]].hub.stats()["coin_issue_batches"]
         ),
     }
 
@@ -366,6 +404,9 @@ def compare(
         ("frames_decoded", "frame-decode"),
         ("mac_verifies", "MAC-verify"),
         ("handler_dispatches", "handler-dispatch"),
+        ("frames_encoded", "frame-encode"),
+        ("mac_signs", "MAC-sign"),
+        ("coin_dispatches", "coin-dispatch"),
     ):
         history = [
             r[counter] for r in trend if isinstance(r.get(counter), int)

@@ -444,6 +444,74 @@ class Det005RosterVersionAccessor:
 
 
 # ---------------------------------------------------------------------------
+# DET006: egress must cross the wave signer per WAVE
+# ---------------------------------------------------------------------------
+#
+# The egress columnarization (ISSUE 13) moved the outbound signer
+# boundary to wave granularity: a coalescer flush hands its whole wave
+# of folded bundles to ONE ``Authenticator.sign_wire_wave`` call,
+# which encodes each distinct payload body once (shared-prefix
+# FrameEncodeMemo) and runs the wave's HMACs as one batched pass.  A
+# per-frame ``sign_wire_many(...)`` / ``encode_message(...)`` call
+# from protocol/ code or a transport send path silently erodes that
+# seam back to one envelope encode + sign pass per post — the exact
+# redundancy the wave signer removed.  The sanctioned sites (the
+# scalar byte-equivalence comparison arm behind
+# Config.egress_columnar=False, pre-pool boot traffic, non-endpoint
+# test rigs, and the wave signer's own per-item defaults in base.py)
+# carry allow[DET006] pragmas with justifications; transport/message.py
+# is the codec itself and exempt.
+
+_DET006_CALLS = frozenset(
+    ("sign_wire_many", "encode_message", "sign_wire")
+)
+_DET006_EXEMPT_FILES = frozenset(("message.py",))
+
+
+@rule
+class Det006EgressWaveSeam:
+    id = "DET006"
+    doc = (
+        "no per-frame envelope encode+sign (sign_wire_many/"
+        "encode_message) from protocol/ or transport send paths "
+        "outside the wave signer; buffer the egress wave and sign it "
+        "in one sign_wire_wave call"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        parts = ctx.relpath.split("/")
+        if (
+            "transport" not in parts and "protocol" not in parts
+        ) or parts[-1] in _DET006_EXEMPT_FILES:
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            name = None
+            if isinstance(func, ast.Attribute):
+                if func.attr in _DET006_CALLS:
+                    name = func.attr
+            elif isinstance(func, ast.Name):
+                # from-imported codec function (ctx.resolve maps the
+                # local name through import aliases)
+                dotted = ctx.resolve(func)
+                if (
+                    dotted
+                    and dotted.rsplit(".", 1)[-1] in _DET006_CALLS
+                ):
+                    name = func.id
+            if name is not None:
+                yield ctx.finding(
+                    self.id,
+                    node,
+                    f"per-frame {name}() encode+sign bypasses the "
+                    "wave signer seam; buffer the egress wave and "
+                    "sign it in one sign_wire_wave call",
+                )
+
+
+# ---------------------------------------------------------------------------
 # CONC001: lock discipline for @guarded_by-annotated attributes
 # ---------------------------------------------------------------------------
 #

@@ -280,6 +280,15 @@ def summarize(doc: dict) -> dict:
         "router_waves": 0,
         "router_payloads": 0,
         "router_dispatches": 0,
+        # egress columnarization (ISSUE 13): one transport/frame_encode
+        # span per egress wave (args: bundle count + encode-memo hits)
+        # and one coin/share_batch span per native coin-issue dispatch
+        # (args: items + distinct owners) — the send-side twins
+        "frame_encode_waves": 0,
+        "frame_encode_bundles": 0,
+        "encode_memo_hits": 0,
+        "coin_share_batches": 0,
+        "coin_share_items": 0,
     }
     batch_widths: List[float] = []
     for ev in _analysis_events(doc):
@@ -308,6 +317,17 @@ def summarize(doc: dict) -> dict:
             width = args.get("batch_width")
             if isinstance(width, (int, float)):
                 batch_widths.append(float(width))
+        elif cat == "transport" and ev["name"] == "frame_encode":
+            # NOTE: "bundles" (folded envelopes per wave) is a
+            # different unit than the metrics counter frames_encoded
+            # (payload BODIES actually encoded) — named apart so a
+            # trace report is never cross-read as that counter
+            delivery["frame_encode_waves"] += 1
+            delivery["frame_encode_bundles"] += int(args.get("frames", 1))
+            delivery["encode_memo_hits"] += int(args.get("memo_hits", 0))
+        elif cat == "coin" and ev["name"] == "share_batch":
+            delivery["coin_share_batches"] += 1
+            delivery["coin_share_items"] += int(args.get("n", 0))
         elif cat == "router" and ev["name"] == "route":
             delivery["router_waves"] += 1
             delivery["router_payloads"] += int(args.get("payloads", 0))
