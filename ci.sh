@@ -9,13 +9,18 @@
 #      image bakes no third-party formatter; the gate enforces this
 #      tree's deterministic style invariants — parseability, LF, EOF
 #      newline, no tabs/trailing whitespace, <= 99 cols — stdlib-only)
-#   2. staticcheck gate    — tools/staticcheck: the determinism-plane
-#      AST analyzer (DET001 wall clocks/entropy, DET002 set-iteration
-#      hash order, CONC001 @guarded_by lock discipline, CONC002
-#      blocking calls in handlers, ERR001 swallowed exceptions).
-#      Fails on ANY unbaselined finding; the committed baseline is
-#      empty — every sanctioned exception is a justified pragma.
-#      Sub-second and stdlib-only, so CI_FAST runs it too.
+#   2. staticcheck gate    — tools/staticcheck: the two-pass
+#      whole-program analyzer over the package + tools + tests
+#      (per-file rules DET001-DET006/CONC001/CONC002/ERR001 plus the
+#      cross-module registry rules WIRE001 wire-kind/pb-tag coverage,
+#      SCHEMA001 counter/snapshot/golden-exposition parity, ARM001
+#      arm-flag/wave-seam/fingerprint parity, VERIFY001
+#      verify-before-dispatch taint walk), with --audit-pragmas
+#      failing on stale pragmas and pragma-count growth past the
+#      budget in baseline.json.  Fails on ANY unbaselined finding;
+#      the committed baseline is empty — every sanctioned exception
+#      is a justified pragma.  A few seconds and stdlib-only, so
+#      CI_FAST runs it too.  Rule catalog: docs/STATICCHECK.md.
 #   3. observability gate  — a seeded 4-node traced cluster captures
 #      a flight-recorder artifact (utils/trace.py) and
 #      tools/tracetool.py --validate gates its schema + per-node
@@ -57,8 +62,8 @@ echo "== [1/9] syntax + format gate"
 python -m compileall -q cleisthenes_tpu tests bench.py __graft_entry__.py
 python tools/format_gate.py
 
-echo "== [2/9] staticcheck gate: determinism plane + lock discipline"
-python -m tools.staticcheck cleisthenes_tpu
+echo "== [2/9] staticcheck gate: whole-program registry + determinism plane"
+python -m tools.staticcheck cleisthenes_tpu tools tests --audit-pragmas
 
 echo "== [3/9] observability gate: traced seeded cluster -> tracetool --validate"
 TRACE_ARTIFACT="$(mktemp /tmp/cleisthenes_trace_ci.XXXXXX.json)"

@@ -17,6 +17,25 @@ import dataclasses
 from typing import Optional
 
 
+# The both-arms registry (staticcheck ARM001): every flag named here
+# selects between a fast path and a LIVE byte-equivalence comparison
+# arm, and the whole-program analyzer cross-checks the declaration —
+# each entry must be a bool Config field, read by the package, pinned
+# explicitly (flag=True/False) in the equivalence tests, and a
+# perfgate fingerprint key (a mode flip must never gate against the
+# other mode's trend records); every ``*_wave`` entry point must be
+# reachable from a module that reads one of these flags.  Adding an
+# arm seam = add its flag here + the fingerprint key + the pinned
+# equivalence test, or the analyzer gates the merge.
+ARM_FLAGS = (
+    "epoch_pipelining",
+    "hub_wave_flush",
+    "order_then_settle",
+    "delivery_columnar",
+    "wave_routing",
+    "egress_columnar",
+)
+
 DEFAULT_DIAL_TIMEOUT_S = 3.0  # reference comm.go:107-109
 DEFAULT_CHANNEL_CAPACITY = 200  # reference conn.go:60-61 (out/read chans)
 # Self-healing dial layer (transport/host.py): first retry delay and

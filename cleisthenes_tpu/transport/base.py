@@ -167,7 +167,7 @@ class Authenticator(abc.ABC):
         from cleisthenes_tpu.transport.message import encode_message
 
         return {
-            rid: encode_message(self.sign(msg, rid))  # staticcheck: allow[DET006] signer default
+            rid: encode_message(self.sign(msg, rid))
             for rid in receiver_ids
         }
 
@@ -187,7 +187,7 @@ class Authenticator(abc.ABC):
         backends override to run the whole wave's HMACs as one batched
         pass over the PR-7 precomputed key schedules."""
         return [
-            self.sign_wire_many(m, rids)  # staticcheck: allow[DET006] signer's own default
+            self.sign_wire_many(m, rids)
             for m, rids in items
         ]
 
@@ -233,7 +233,7 @@ class NullAuthenticator(Authenticator):
         one encode per broadcast."""
         from cleisthenes_tpu.transport.message import encode_message
 
-        wire = encode_message(msg)  # staticcheck: allow[DET006] null: one shared encode
+        wire = encode_message(msg)
         return {rid: wire for rid in receiver_ids}
 
     def verify_wire_many(self, msgs, signing_prefixes) -> "List[bool]":
@@ -415,7 +415,7 @@ class HmacAuthenticator(Authenticator):
                 f"cannot sign as {msg.sender_id!r}: this authenticator "
                 f"holds the keys of {self._self_id!r}"
             )
-        sb = signing_bytes(msg)  # staticcheck: allow[DET006] scalar arm signer
+        sb = signing_bytes(msg)
         macs = self._macs
         out: Dict[str, bytes] = {}
         for rid in receiver_ids:

@@ -39,9 +39,11 @@ from cleisthenes_tpu.transport.message import (
     Payload,
     RbcPayload,
     ResharePayload,
+    _KIND_BBA,
     _KIND_CATCHUP_ORD,
     _KIND_CATCHUP_REQ,
     _KIND_CATCHUP_RESP,
+    _KIND_RBC,
     _KIND_RESHARE,
     _encode_payload,
     _decode_payload,
@@ -49,6 +51,15 @@ from cleisthenes_tpu.transport.message import (
 
 _WT_VARINT = 0
 _WT_LEN = 2
+
+# The reference oneof numbers its rbc/bba slots 3 and 4
+# (message.proto:18-22) and our native kind registry deliberately
+# keeps the SAME numbers (message.py:300-302), so the oneof tags ARE
+# the kind constants — spelled by name here so the wire registry
+# analyzer (staticcheck WIRE001) sees the coverage and a renumbering
+# on either side cannot drift silently.
+_PB_TAG_RBC = _KIND_RBC
+_PB_TAG_BBA = _KIND_BBA
 
 # Extension slots beyond the reference's oneof (message.proto stops at
 # bba=4): the crash-recovery CATCHUP pair rides high tag numbers as
@@ -145,9 +156,9 @@ def encode_pb_message(msg: Message) -> bytes:
     the reference never reached, with no slot in its contract."""
     p = msg.payload
     if isinstance(p, RbcPayload):
-        one = _len_field(3, _inner_body(3, p))
+        one = _len_field(_PB_TAG_RBC, _inner_body(_PB_TAG_RBC, p))
     elif isinstance(p, BbaPayload):
-        one = _len_field(4, _inner_body(4, p))
+        one = _len_field(_PB_TAG_BBA, _inner_body(_PB_TAG_BBA, p))
     elif isinstance(p, CatchupReqPayload):
         _k, tlv = _encode_payload(p)
         one = _len_field(_PB_TAG_CATCHUP_REQ, _len_field(1, tlv))
@@ -188,7 +199,8 @@ def decode_pb_message(data: bytes, sender_id: str = "") -> Message:
             # unknown scalar fields skip per proto3 semantics (forward
             # compatibility); the KNOWN tags are all length-delimited
             if tag in (
-                1, 2, 3, 4, _PB_TAG_CATCHUP_REQ, _PB_TAG_CATCHUP_RESP,
+                1, 2, _PB_TAG_RBC, _PB_TAG_BBA,
+                _PB_TAG_CATCHUP_REQ, _PB_TAG_CATCHUP_RESP,
                 _PB_TAG_CATCHUP_ORD, _PB_TAG_RESHARE,
             ):
                 raise ValueError(
@@ -214,7 +226,7 @@ def decode_pb_message(data: bytes, sender_id: str = "") -> Message:
             signature = body
         elif tag == 2:
             ts = _parse_timestamp(body)
-        elif tag in (3, 4):
+        elif tag in (_PB_TAG_RBC, _PB_TAG_BBA):
             payload = _parse_inner(tag, body)
         elif tag in (
             _PB_TAG_CATCHUP_REQ, _PB_TAG_CATCHUP_RESP,
@@ -273,7 +285,7 @@ def _parse_inner(tag: int, body: bytes) -> Payload:
             _val, o = _read_varint(body, o)  # type enum: informational
         else:
             raise ValueError(f"unexpected wire type {wt} in RBC/BBA")
-    kind = 3 if tag == 3 else 4
+    kind = _KIND_RBC if tag == _PB_TAG_RBC else _KIND_BBA
     payload = _decode_payload(kind, tlv)
     return payload
 

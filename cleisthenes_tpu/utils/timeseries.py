@@ -45,7 +45,7 @@ def _now() -> float:
     """The sampler's clock (monotonic: series are for rate/age math,
     never wall-calendar display).  Confined here the way
     TraceRecorder.now() confines the trace clock."""
-    return time.monotonic()  # staticcheck: allow[DET001] telemetry sampling clock
+    return time.monotonic()  # telemetry clock (outside the determinism plane)
 
 
 def flatten_snapshot(

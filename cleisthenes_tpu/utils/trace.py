@@ -111,7 +111,7 @@ class TraceRecorder:
     def now() -> float:
         """The observability clock.  Pure data: nothing in the
         protocol plane may branch on this value."""
-        return time.perf_counter()  # staticcheck: allow[DET001] pure observability
+        return time.perf_counter()  # pure observability (outside the plane)
 
     # -- recording ---------------------------------------------------------
 

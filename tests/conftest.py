@@ -28,6 +28,13 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
+# The staticcheck fixture corpus is analyzer test DATA, not a test
+# suite: the cross-module registry trees under staticcheck_fixtures/
+# carry miniature test_*.py files (flag-pin registries) that must
+# never be collected as tests — they import modules that exist only
+# relative to their own mini tree roots.
+collect_ignore_glob = ["staticcheck_fixtures/*"]
+
 
 @pytest.fixture(scope="session")
 def jax_cpu_devices():

@@ -1,0 +1,18 @@
+"""known-bad ARM001: an arm registry declaring a flag that is not a
+bool Config field, a flag nothing ever reads (a dead arm whose scalar
+twin cannot be reachable), and a wave entry point no arm-flag-reading
+module reaches (a wave seam with no Config-flag gate)."""
+
+import dataclasses
+
+ARM_FLAGS = ("ab_phantom_arm", "ab_dead_arm")  # BAD:ARM001
+
+
+@dataclasses.dataclass
+class Config:
+    ab_dead_arm: bool = True  # BAD:ARM001
+    batch: int = 8
+
+
+def handle_ab_wave(items):  # BAD:ARM001
+    return [i for i in items]

@@ -1,0 +1,31 @@
+"""known-good ARM001: the declared arm flag is a bool Config field,
+read as the gate that selects between the wave entry point and its
+scalar twin — so the wave seam is reachable from an arm-flag reader
+and the scalar arm stays live."""
+
+import dataclasses
+
+ARM_FLAGS = ("ag_live_arm",)
+
+
+@dataclasses.dataclass
+class Config:
+    ag_live_arm: bool = True
+    batch: int = 8
+
+
+def handle_ag_wave(items):
+    return [i for i in items]
+
+
+class Plane:
+    def __init__(self, config):
+        self._wave = bool(config.ag_live_arm)
+
+    def ingest(self, items):
+        if self._wave:
+            return handle_ag_wave(items)
+        return [self.ingest_one(i) for i in items]
+
+    def ingest_one(self, item):
+        return item

@@ -1,0 +1,29 @@
+"""known-bad SCHEMA001: a metrics registry with a counter nothing
+ever increments and a counter that is incremented but never reaches
+the snapshot schema (silent dashboard drift — the exact hazard the
+zeroed-key snapshot rule of PRs 9/10/13 exists for)."""
+
+
+class Counter:
+    def __init__(self):
+        self.value = 0
+
+    def inc(self, by=1):
+        self.value += by
+
+
+class BadMetrics:
+    def __init__(self):
+        self.sc_orphan_total = Counter()  # BAD:SCHEMA001
+        self.sc_ghost_total = Counter()  # BAD:SCHEMA001
+        self.sc_good_total = Counter()
+
+    def bump(self):
+        self.sc_ghost_total.inc()
+        self.sc_good_total.inc()
+
+    def snapshot(self):
+        return {
+            "sc_orphan_total": self.sc_orphan_total.value,
+            "sc_good_total": self.sc_good_total.value,
+        }

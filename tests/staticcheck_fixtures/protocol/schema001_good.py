@@ -1,0 +1,27 @@
+"""known-good SCHEMA001: every declared counter is incremented
+somewhere and read into the snapshot schema."""
+
+
+class Counter:
+    def __init__(self):
+        self.value = 0
+
+    def inc(self, by=1):
+        self.value += by
+
+
+class GoodMetrics:
+    def __init__(self):
+        self.sg_reqs_total = Counter()
+        self.sg_errs_total = Counter()
+
+    def bump(self, failed):
+        self.sg_reqs_total.inc()
+        if failed:
+            self.sg_errs_total.inc()
+
+    def snapshot(self):
+        return {
+            "sg_reqs_total": self.sg_reqs_total.value,
+            "sg_errs_total": self.sg_errs_total.value,
+        }

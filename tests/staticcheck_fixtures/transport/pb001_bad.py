@@ -1,0 +1,12 @@
+"""known-bad WIRE001 (pb side): an extension-tag registry with a
+reused tag number, a tag landing on the reference envelope's reserved
+numbers, and a declared-but-never-used tag."""
+
+_PB_TAG_X = 15
+_PB_TAG_Y = 15  # BAD:WIRE001
+_PB_TAG_Z = 2  # BAD:WIRE001
+_PB_TAG_W = 19  # BAD:WIRE001
+
+
+def encode_tags():
+    return (_PB_TAG_X, _PB_TAG_Y, _PB_TAG_Z)

@@ -1,0 +1,9 @@
+"""known-good WIRE001 (pb side): unique extension tags off the
+reserved envelope numbers, every declared tag used by the adapter."""
+
+_PB_TAG_X = 15
+_PB_TAG_Y = 16
+
+
+def encode_tags():
+    return (_PB_TAG_X, _PB_TAG_Y)

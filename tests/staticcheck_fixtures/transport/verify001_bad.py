@@ -1,0 +1,20 @@
+"""known-bad VERIFY001: a receive path that decodes network-origin
+frames and hands them to the handler with NO verify_wire* between
+decode and dispatch — Byzantine bytes reaching the protocol plane
+unauthenticated, the exact hole the reference left open
+(conn.go:134-137 TODO)."""
+
+from cleisthenes_tpu.transport.message import decode_frame
+
+
+class RawPath:
+    def __init__(self, handler, auth):
+        self._handler = handler
+        self._auth = auth
+
+    def pump(self, frames):
+        wave = []
+        for data in frames:
+            msg, prefix = decode_frame(data)
+            wave.append(msg)
+        self._handler.serve_wave(wave)  # BAD:VERIFY001

@@ -1,0 +1,20 @@
+"""known-good VERIFY001: the same receive path with the MAC check in
+place — frames failing verify_wire never reach the handler, and the
+dispatched wave derives only from verified values."""
+
+from cleisthenes_tpu.transport.message import decode_frame
+
+
+class VerifiedPath:
+    def __init__(self, handler, auth):
+        self._handler = handler
+        self._auth = auth
+
+    def pump(self, frames):
+        wave = []
+        for data in frames:
+            msg, prefix = decode_frame(data)
+            if not self._auth.verify_wire(msg, prefix):
+                continue
+            wave.append(msg)
+        self._handler.serve_wave(wave)

@@ -1,0 +1,30 @@
+"""known-bad WIRE001: a payload-kind registry with a reused number,
+a kind no parser accepts, and a kind no encoder emits.  A miniature
+transport/message.py — the registry index detects the ``_KIND_*``
+module constants and cross-checks them against the encode returns and
+the parse comparisons in the same module."""
+
+_KIND_ALPHA = 3
+_KIND_BETA = 3  # BAD:WIRE001
+_KIND_GAMMA = 5  # BAD:WIRE001
+_KIND_DELTA = 6  # BAD:WIRE001
+
+
+def _encode_payload(p):
+    if isinstance(p, tuple):
+        return _KIND_ALPHA, b"a"
+    if isinstance(p, list):
+        return _KIND_BETA, b"b"
+    if isinstance(p, dict):
+        return _KIND_GAMMA, b"g"
+    raise TypeError(type(p))
+
+
+def _parse_payload(kind, data):
+    if kind == _KIND_ALPHA:
+        return ("alpha", data)
+    if kind == _KIND_BETA:
+        return ["beta", data]
+    if kind == _KIND_DELTA:
+        return {"delta": data}
+    raise ValueError(kind)

@@ -1,0 +1,21 @@
+"""known-good WIRE001: every kind carries a unique number, an encode
+return and a parse comparison.  No pb adapter imports this module's
+stem, so pb-slot coverage is not demanded here (the cross-module
+fixture tree exercises that pairing)."""
+
+_KIND_ALPHA = 3
+_KIND_BETA = 4
+
+
+def _encode_payload(p):
+    if isinstance(p, tuple):
+        return _KIND_ALPHA, b"a"
+    return _KIND_BETA, b"b"
+
+
+def _parse_payload(kind, data):
+    if kind == _KIND_ALPHA:
+        return ("alpha", data)
+    if kind == _KIND_BETA:
+        return ["beta", data]
+    raise ValueError(kind)

@@ -1,0 +1,13 @@
+"""xmodule-bad config: xb_turbo is missing from the perfgate
+fingerprint; xb_nitro is never pinned in the equivalence tests."""
+
+import dataclasses
+
+ARM_FLAGS = ("xb_turbo", "xb_nitro")
+
+
+@dataclasses.dataclass
+class Config:
+    xb_turbo: bool = True
+    xb_nitro: bool = True
+    batch: int = 8

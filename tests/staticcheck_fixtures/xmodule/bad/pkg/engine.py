@@ -1,0 +1,14 @@
+"""xmodule-bad engine: reads both arm flags (so neither is a dead
+arm) and increments both counters (so the schema drift is about the
+snapshot, not about dead metrics)."""
+
+
+class Engine:
+    def __init__(self, config, metrics):
+        self._wave = bool(config.xb_turbo) and bool(config.xb_nitro)
+        self.metrics = metrics
+
+    def step(self, ok):
+        self.metrics.xb_reqs_total.inc()
+        if not ok:
+            self.metrics.xb_lost_total.inc()

@@ -1,0 +1,19 @@
+"""xmodule-bad metrics: xb_lost_total is incremented by the engine
+but never reaches the snapshot schema (silent dashboard drift)."""
+
+
+class Counter:
+    def __init__(self):
+        self.value = 0
+
+    def inc(self, by=1):
+        self.value += by
+
+
+class Metrics:
+    def __init__(self):
+        self.xb_reqs_total = Counter()
+        self.xb_lost_total = Counter()
+
+    def snapshot(self):
+        return {"xb_reqs_total": self.xb_reqs_total.value}

@@ -1,0 +1,15 @@
+"""xmodule-bad exposition: xb_stray_total is emitted but absent
+from the golden; the golden's xb_ghost_total is never emitted."""
+
+
+def render(exp, metrics, labels):
+    exp.add(
+        exp.family("xb_foo_total", "counter", "requests"),
+        labels,
+        metrics.xb_reqs_total.value,
+    )
+    exp.add(
+        exp.family("xb_stray_total", "counter", "strays"),
+        labels,
+        0,
+    )

@@ -1,0 +1,12 @@
+"""xmodule-bad pb adapter: pairs with wiremsg via the import stem
+but only carries _KIND_ONE."""
+
+from pkg.transport.wiremsg import _KIND_ONE
+
+_PB_TAG_ONE = 15
+
+
+def encode_pb(kind, body):
+    if kind == _KIND_ONE:
+        return (_PB_TAG_ONE, body)
+    raise ValueError(kind)

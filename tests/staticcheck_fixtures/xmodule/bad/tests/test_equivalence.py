@@ -1,0 +1,8 @@
+"""xmodule-bad equivalence tests: xb_turbo is pinned on both arms;
+xb_nitro never is."""
+
+from pkg.config import Config
+
+
+def test_turbo_arms():
+    assert Config(xb_turbo=False).batch == Config(xb_turbo=True).batch

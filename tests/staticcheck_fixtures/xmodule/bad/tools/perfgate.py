@@ -1,0 +1,12 @@
+"""xmodule-bad perfgate: the fingerprint carries xb_nitro but NOT
+xb_turbo."""
+
+
+def sample(cfg):
+    return {
+        "kind": "mini",
+        "fingerprint": {
+            "kind": "mini",
+            "xb_nitro": bool(cfg.xb_nitro),
+        },
+    }

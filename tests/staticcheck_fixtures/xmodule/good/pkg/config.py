@@ -1,0 +1,11 @@
+"""xmodule-good config: the arm flag is fingerprinted and pinned."""
+
+import dataclasses
+
+ARM_FLAGS = ("xg_turbo",)
+
+
+@dataclasses.dataclass
+class Config:
+    xg_turbo: bool = True
+    batch: int = 8

@@ -1,0 +1,17 @@
+"""xmodule-good metrics: every counter incremented and exported."""
+
+
+class Counter:
+    def __init__(self):
+        self.value = 0
+
+    def inc(self, by=1):
+        self.value += by
+
+
+class Metrics:
+    def __init__(self):
+        self.xg_reqs_total = Counter()
+
+    def snapshot(self):
+        return {"xg_reqs_total": self.xg_reqs_total.value}

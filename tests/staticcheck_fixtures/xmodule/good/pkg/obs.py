@@ -1,0 +1,9 @@
+"""xmodule-good exposition: families match the golden exactly."""
+
+
+def render(exp, metrics, labels):
+    exp.add(
+        exp.family("xg_foo_total", "counter", "requests"),
+        labels,
+        metrics.xg_reqs_total.value,
+    )

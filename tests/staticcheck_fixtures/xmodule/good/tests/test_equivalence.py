@@ -1,0 +1,7 @@
+"""xmodule-good equivalence tests: the scalar arm is pinned."""
+
+from pkg.config import Config
+
+
+def test_turbo_arms():
+    assert Config(xg_turbo=False).batch == Config(xg_turbo=True).batch

@@ -1,0 +1,11 @@
+"""xmodule-good perfgate: the fingerprint keys on the arm flag."""
+
+
+def sample(cfg):
+    return {
+        "kind": "mini",
+        "fingerprint": {
+            "kind": "mini",
+            "xg_turbo": bool(cfg.xg_turbo),
+        },
+    }

@@ -87,6 +87,23 @@ BAD_FIXTURES = [
     # one-sign-pass-per-wave discipline can't silently erode back to
     # one encode + MAC per post
     "transport/det006_bad.py",
+    # the wire registry (ISSUE 14): duplicate kind numbers, kinds no
+    # parser accepts and kinds no encoder emits gate at the registry
+    # declaration — the two-pass index works on a single file too
+    "transport/wire001_bad.py",
+    # ...and the pb-adapter side: duplicate extension tags, reserved
+    # envelope numbers, orphaned tags
+    "transport/pb001_bad.py",
+    # the snapshot-schema registry (ISSUE 14): counters nothing
+    # increments and counters that never reach snapshot() gate at the
+    # declaration line
+    "protocol/schema001_bad.py",
+    # the arm registry (ISSUE 14): stale ARM_FLAGS entries, dead arm
+    # flags and wave entry points with no arm-flag gate
+    "protocol/arm001_bad.py",
+    # the verify-before-dispatch taint walk (ISSUE 14): decoded frames
+    # reaching a handler sink with no verify_wire* in between
+    "transport/verify001_bad.py",
     "protocol/conc001_bad.py",
     "transport/conc002_bad.py",
     "protocol/err001_bad.py",
@@ -98,6 +115,11 @@ GOOD_FIXTURES = [
     "transport/det004_good.py",
     "protocol/det005_good.py",
     "transport/det006_good.py",
+    "transport/wire001_good.py",
+    "transport/pb001_good.py",
+    "protocol/schema001_good.py",
+    "protocol/arm001_good.py",
+    "transport/verify001_good.py",
     "protocol/conc001_good.py",
     "transport/conc002_good.py",
     "protocol/err001_good.py",
@@ -170,13 +192,27 @@ def test_baseline_round_trip(tmp_path):
 
 
 def test_fixture_corpus_walk():
-    findings, n_files = check_paths([FIXTURES], REPO)
+    # the per-rule corpus lives under protocol/ + transport/ (the
+    # cross-module registry tree under xmodule/ has its own walk test
+    # in tests/test_staticcheck_program.py)
+    findings, n_files = check_paths(
+        [FIXTURES / "protocol", FIXTURES / "transport"], REPO
+    )
     assert n_files == len(BAD_FIXTURES) + len(GOOD_FIXTURES) + 1
     tagged = sum(
         len(expected_findings(FIXTURES / rel)) for rel in BAD_FIXTURES
     )
     # corpus-wide: every tagged line + the two pragma_cases findings
     assert len(findings) == tagged + 2
+
+
+def test_tree_walks_skip_the_fixture_corpus():
+    # scanning tests/ must NOT drown in the corpus's deliberate
+    # findings: the walker treats staticcheck_fixtures as test data
+    # unless a target points inside it
+    findings, n_files = check_paths([REPO / "tests"], REPO)
+    assert n_files > 0
+    assert not any("staticcheck_fixtures" in f.path for f in findings)
 
 
 def test_rule_catalog_registered():
@@ -190,6 +226,10 @@ def test_rule_catalog_registered():
         "CONC001",
         "CONC002",
         "ERR001",
+        "WIRE001",
+        "SCHEMA001",
+        "ARM001",
+        "VERIFY001",
     }
 
 

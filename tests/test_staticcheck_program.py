@@ -1,0 +1,257 @@
+"""The whole-program analyzer (ISSUE 14): cross-module registry
+index, audit mode, SARIF output, and the wall-budget regression.
+
+The per-rule fixture corpus rides tests/test_staticcheck.py; this
+module covers what only the TWO-PASS analysis can see — the
+cross-module fixture trees under tests/staticcheck_fixtures/xmodule/
+stand up miniature wire/pb, metrics/exposition/golden, and
+config/perfgate/tests registries and assert the exact cross-file
+findings (bad) and a clean bill (good)."""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import subprocess
+import sys
+import time
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from tools.staticcheck.core import (  # noqa: E402
+    check_paths,
+    load_pragma_budget,
+)
+
+XMODULE = REPO / "tests" / "staticcheck_fixtures" / "xmodule"
+
+
+def _findings(root):
+    found, _n = check_paths([root], root)
+    return {(f.rule, f.path, f.line) for f in found}
+
+
+def test_xmodule_bad_tree_exact_cross_module_findings():
+    """Each defect lives in a DIFFERENT file from the registry that
+    convicts it: flag vs fingerprint/tests, counter vs snapshot,
+    family vs golden, kind vs pb adapter."""
+    assert _findings(XMODULE / "bad") == {
+        # xb_turbo is read+pinned but missing from tools/perfgate.py's
+        # fingerprint dict
+        ("ARM001", "pkg/config.py", 11),
+        # xb_nitro is read+fingerprinted but never pinned in tests/
+        ("ARM001", "pkg/config.py", 12),
+        # xb_lost_total is incremented in pkg/engine.py but never
+        # reaches pkg/metrics.py's snapshot()
+        ("SCHEMA001", "pkg/metrics.py", 16),
+        # the golden's xb_ghost_total is emitted by no exposition
+        ("SCHEMA001", "pkg/obs.py", 1),
+        # xb_stray_total is emitted but absent from the golden
+        ("SCHEMA001", "pkg/obs.py", 12),
+        # _KIND_TWO has no slot in the import-stem-paired pb adapter
+        ("WIRE001", "pkg/transport/wiremsg.py", 5),
+    }
+
+
+def test_xmodule_good_tree_is_clean():
+    assert _findings(XMODULE / "good") == set()
+
+
+def test_xmodule_good_breaks_when_fingerprint_key_removed(tmp_path):
+    """The index really reads the OTHER file: deleting the good
+    tree's fingerprint key manufactures the ARM001 finding."""
+    import shutil
+
+    root = tmp_path / "tree"
+    shutil.copytree(XMODULE / "good", root)
+    pg = root / "tools" / "perfgate.py"
+    pg.write_text(
+        pg.read_text(encoding="utf-8").replace(
+            '"xg_turbo": bool(cfg.xg_turbo),', ""
+        ),
+        encoding="utf-8",
+    )
+    rules = {f[0] for f in _findings(root)}
+    assert rules == {"ARM001"}
+
+
+# ---------------------------------------------------------------------------
+# audit mode
+# ---------------------------------------------------------------------------
+
+
+def _write_plane_file(tmp_path, body):
+    mod = tmp_path / "protocol" / "mod.py"
+    mod.parent.mkdir(exist_ok=True)
+    mod.write_text(body, encoding="utf-8")
+    return mod
+
+
+# assembled from pieces so the tree-wide audit of THIS file's source
+# never sees a pragma-shaped line of its own
+_P = "# staticcheck" + ": "
+AUDIT_SRC = (
+    "import time\n"
+    "\n"
+    "\n"
+    "def f():\n"
+    "    return time.time()  " + _P + "allow[DET001] sanctioned\n"
+    "x = 1  " + _P + "allow[DET002] nothing ever fired here\n"
+)
+
+
+def test_audit_reports_stale_pragma_and_keeps_live_one(tmp_path):
+    _write_plane_file(tmp_path, AUDIT_SRC)
+    findings, _n = check_paths(
+        [tmp_path], tmp_path, audit=True, pragma_budget=None
+    )
+    by_rule = {}
+    for f in findings:
+        by_rule.setdefault(f.rule, []).append(f)
+    # the DET001 pragma suppresses a real finding: not stale; the
+    # DET002 pragma suppresses nothing: PRAGMA002 at its exact line
+    assert "DET001" not in by_rule
+    stale = by_rule.pop("PRAGMA002")
+    assert [(f.line) for f in stale] == [6]
+    assert "allow-file" not in stale[0].message
+    assert not by_rule  # nothing else
+
+
+def test_audit_budget_gates_pragma_growth(tmp_path):
+    _write_plane_file(tmp_path, AUDIT_SRC)
+    over, _n = check_paths(
+        [tmp_path], tmp_path, audit=True, pragma_budget=1
+    )
+    assert any(f.rule == "PRAGMA003" for f in over)
+    under, _n = check_paths(
+        [tmp_path], tmp_path, audit=True, pragma_budget=2
+    )
+    assert not any(f.rule == "PRAGMA003" for f in under)
+
+
+def test_tree_pragma_budget_matches_population():
+    """The committed budget is EXACT: adding a pragma anywhere in the
+    gated tree must force a deliberate budget bump in review."""
+    budget = load_pragma_budget()
+    assert budget is not None
+    targets = [REPO / p for p in ("cleisthenes_tpu", "tools", "tests")]
+    findings, _n = check_paths(
+        targets, REPO, audit=True, pragma_budget=budget
+    )
+    assert [f.render() for f in findings] == []
+    over, _n = check_paths(
+        targets, REPO, audit=True, pragma_budget=budget - 1
+    )
+    assert any(f.rule == "PRAGMA003" for f in over)
+
+
+# ---------------------------------------------------------------------------
+# CLI: SARIF output + the wall-budget regression
+# ---------------------------------------------------------------------------
+
+
+def _run_cli(*args, timeout=120):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.staticcheck", *args],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+    )
+
+
+def test_lone_real_file_scan_has_no_standing_to_convict_absence():
+    """Single-file runs of the real registry modules must stay clean:
+    'never incremented' / 'never read' / wave-unreachable are claims
+    about consumers the scan cannot see (self-contained fixtures keep
+    the full rule set — tests/test_staticcheck.py proves they still
+    gate)."""
+    for rel in (
+        "cleisthenes_tpu/utils/metrics.py",
+        "cleisthenes_tpu/config.py",
+        "cleisthenes_tpu/protocol/acs.py",
+    ):
+        findings, _n = check_paths([REPO / rel], REPO)
+        assert [f.render() for f in findings] == [], rel
+
+
+def test_rules_subset_does_not_fake_stale_pragmas():
+    """--rules narrows the REPORT, not the audit's evidence: pragma
+    staleness is judged against every rule's raw findings, so a
+    DET001-only run must not declare the WIRE001/DET004 pragmas
+    stale."""
+    proc = _run_cli(
+        "cleisthenes_tpu",
+        "tools",
+        "tests",
+        "--rules",
+        "DET001",
+        "--audit-pragmas",
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_fingerprint_registry_prefers_real_perfgate():
+    """Fingerprint-shaped dict literals in tests must not mask a key
+    dropped from the real perfgate fingerprint: with a perfgate.py in
+    the scan, only its keys count."""
+    from tools.staticcheck.core import _load_contexts
+    from tools.staticcheck.program import build_index
+
+    ctxs, _pf, _n = _load_contexts(
+        [REPO / p for p in ("cleisthenes_tpu", "tools", "tests")], REPO
+    )
+    index = build_index(ctxs, REPO)
+    # every declared arm flag keys the real fingerprint...
+    from cleisthenes_tpu.config import ARM_FLAGS
+
+    assert set(ARM_FLAGS) <= index.fingerprint_keys
+    # ...and test_obs's mini record dicts were not unioned in
+    assert "k" not in index.fingerprint_keys
+
+
+def test_sarif_output_is_annotatable():
+    proc = _run_cli(
+        "tests/staticcheck_fixtures/transport/wire001_bad.py",
+        "--format",
+        "sarif",
+        "--no-baseline",
+    )
+    assert proc.returncode == 1
+    doc = json.loads(proc.stdout)
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "cleisthenes-staticcheck"
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert {"WIRE001", "SCHEMA001", "ARM001", "VERIFY001"} <= rule_ids
+    results = run["results"]
+    locs = {
+        (
+            r["ruleId"],
+            r["locations"][0]["physicalLocation"]["artifactLocation"][
+                "uri"
+            ],
+            r["locations"][0]["physicalLocation"]["region"]["startLine"],
+        )
+        for r in results
+    }
+    rel = "tests/staticcheck_fixtures/transport/wire001_bad.py"
+    assert locs == {
+        ("WIRE001", rel, 8),
+        ("WIRE001", rel, 9),
+        ("WIRE001", rel, 10),
+    }
+
+
+def test_whole_program_pass_under_wall_budget():
+    """The two-pass tree-wide run (the exact ci.sh stage-2 command)
+    must stay far from being the slow CI stage: zero findings, and
+    well under a minute on the tier-1 box (typically a few seconds)."""
+    t0 = time.monotonic()
+    proc = _run_cli(
+        "cleisthenes_tpu", "tools", "tests", "--audit-pragmas"
+    )
+    elapsed = time.monotonic() - t0
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert elapsed < 60.0, f"staticcheck took {elapsed:.1f}s"

@@ -283,6 +283,13 @@ def run_sample(
             # batch per node per drain; columnar: one wave pass per
             # flush, one pooled coin dispatch) — same rule
             "egress_columnar": bool(cfg.egress_columnar),
+            # the remaining ARM_FLAGS (config.py): the hub's flush
+            # discipline changes what hub_dispatches MEANS and epoch
+            # pipelining changes what the epoch windows overlap —
+            # every declared arm flag keys the fingerprint
+            # (staticcheck ARM001 cross-checks the set)
+            "hub_wave_flush": bool(cfg.hub_wave_flush),
+            "epoch_pipelining": bool(cfg.epoch_pipelining),
         },
         "epoch_p50_ms": round(p50 * 1000.0, 3),
         "epoch_p95_ms": round(p95 * 1000.0, 3),

@@ -9,14 +9,28 @@ that keeps wall clocks, unseeded randomness, hash-order iteration,
 lock-discipline violations and swallowed exceptions out of the code
 paths where they can fork a ledger.
 
-Layout:
-  core.py   -- Finding/FileContext, pragma parsing, rule registry,
-               baseline round-trip, the runner
-  rules.py  -- the rule catalog (DET001/DET002/CONC001/CONC002/ERR001)
-  __main__  -- CLI: ``python -m tools.staticcheck cleisthenes_tpu``
+Since ISSUE 14 the analyzer is a TWO-PASS whole-program tool: pass 1
+builds a cross-module symbol/registry index (payload kinds and pb
+extension tags, Metrics counters vs snapshot schema vs golden
+exposition, Config arm flags vs wave entry points vs perfgate
+fingerprint keys), pass 2 runs the per-file rules plus the registry
+rules (WIRE001/SCHEMA001/ARM001/VERIFY001) over it, and an audit mode
+machine-checks the pragma population (staleness + count budget).
 
-See docs/ARCHITECTURE.md "Determinism plane & static analysis" for
-the plane definition, the rule catalog, and the pragma policy.
+Layout:
+  core.py           -- Finding/FileContext, pragma parsing + audit,
+                       rule registry, baseline round-trip, the
+                       two-pass runner
+  rules.py          -- the per-file catalog (DET001-DET006, CONC001/
+                       CONC002, ERR001)
+  program.py        -- pass 1: the cross-module registry index
+  registry_rules.py -- pass 2: WIRE001/SCHEMA001/ARM001 (+ VERIFY001)
+  __main__          -- CLI: ``python -m tools.staticcheck
+                       cleisthenes_tpu tools tests --audit-pragmas``
+
+See docs/STATICCHECK.md for the full rule catalog, the pragma grammar
+and the audit mode; docs/ARCHITECTURE.md "Determinism plane & static
+analysis" for the plane definition.
 """
 
 from tools.staticcheck.core import (
@@ -24,17 +38,20 @@ from tools.staticcheck.core import (
     Finding,
     check_paths,
     load_baseline,
+    load_pragma_budget,
     registered_rules,
     split_baselined,
     write_baseline,
 )
 import tools.staticcheck.rules  # noqa: F401  (registers the catalog)
+import tools.staticcheck.registry_rules  # noqa: F401  (registry rules)
 
 __all__ = [
     "BASELINE_PATH",
     "Finding",
     "check_paths",
     "load_baseline",
+    "load_pragma_budget",
     "registered_rules",
     "split_baselined",
     "write_baseline",

@@ -2,13 +2,20 @@
 
 Usage:
     python -m tools.staticcheck cleisthenes_tpu            # gate mode
-    python -m tools.staticcheck cleisthenes_tpu --json     # full JSON
+    python -m tools.staticcheck cleisthenes_tpu tools tests \
+        --audit-pragmas                                    # ci stage 2
+    python -m tools.staticcheck cleisthenes_tpu --format json
+    python -m tools.staticcheck cleisthenes_tpu --format sarif
     python -m tools.staticcheck pkg --write-baseline       # grandfather
     python -m tools.staticcheck pkg --no-baseline          # raw view
 
 Exit 0 iff no unbaselined findings.  Gate mode prints one line per
 fresh finding plus a one-line JSON summary (machine-greppable in CI
-logs) and the human summary via the shared reporter.
+logs) and the human summary via the shared reporter.  ``--format
+sarif`` emits SARIF 2.1.0 so editors and CI annotate findings in
+place; ``--audit-pragmas`` re-runs every rule unsuppressed and fails
+on stale pragmas (PRAGMA002) or pragma-population growth past the
+budget in the baseline file (PRAGMA003).
 """
 
 from __future__ import annotations
@@ -25,10 +32,87 @@ from tools.staticcheck import (  # noqa: E402
     BASELINE_PATH,
     check_paths,
     load_baseline,
+    load_pragma_budget,
     registered_rules,
     split_baselined,
     write_baseline,
 )
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
+
+
+def to_sarif(findings, baselined) -> dict:
+    """SARIF 2.1.0 document: one run, one result per fresh finding
+    (grandfathered findings ride along with 'baseline' suppressions so
+    annotators can hide them)."""
+    rule_ids = sorted(
+        {f.rule for f in findings}
+        | {f.rule for f in baselined}
+        | set(registered_rules())
+    )
+    rules_meta = []
+    catalog = registered_rules()
+    for rid in rule_ids:
+        desc = getattr(catalog.get(rid), "doc", "") or rid
+        rules_meta.append(
+            {
+                "id": rid,
+                "shortDescription": {"text": desc},
+            }
+        )
+
+    def result(f, suppressed: bool) -> dict:
+        out = {
+            "ruleId": f.rule,
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {
+                            "uri": f.path,
+                            "uriBaseId": "SRCROOT",
+                        },
+                        "region": {
+                            "startLine": f.line,
+                            "startColumn": f.col + 1,
+                        },
+                    }
+                }
+            ],
+        }
+        if suppressed:
+            out["suppressions"] = [
+                {"kind": "external", "justification": "baselined"}
+            ]
+        return out
+
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "cleisthenes-staticcheck",
+                        "informationUri": (
+                            "docs/STATICCHECK.md"
+                        ),
+                        "rules": rules_meta,
+                    }
+                },
+                "originalUriBaseIds": {
+                    "SRCROOT": {"uri": REPO_ROOT.as_uri() + "/"}
+                },
+                "results": [result(f, False) for f in findings]
+                + [result(f, True) for f in baselined],
+            }
+        ],
+    }
 
 
 def main(argv=None) -> int:
@@ -40,7 +124,15 @@ def main(argv=None) -> int:
         help="files/dirs to scan (repo-relative; default: the package)",
     )
     ap.add_argument(
-        "--json", action="store_true", help="emit full findings as JSON"
+        "--json",
+        action="store_true",
+        help="emit full findings as JSON (alias for --format json)",
+    )
+    ap.add_argument(
+        "--format",
+        choices=("text", "json", "sarif"),
+        default="text",
+        help="output format (sarif: editor/CI-annotatable 2.1.0)",
     )
     ap.add_argument(
         "--baseline",
@@ -59,17 +151,46 @@ def main(argv=None) -> int:
         help="grandfather the current findings and exit 0",
     )
     ap.add_argument(
+        "--audit-pragmas",
+        action="store_true",
+        help=(
+            "re-run all rules unsuppressed; fail on stale pragmas "
+            "(PRAGMA002) and pragma counts past the budget (PRAGMA003)"
+        ),
+    )
+    ap.add_argument(
         "--rules",
         help="comma-separated rule ids to run (default: all)",
     )
+    ap.add_argument(
+        "--root",
+        type=pathlib.Path,
+        default=None,
+        help=(
+            "analysis root for relative paths and registry "
+            "augmentation (default: the repo root; point it at a "
+            "fixture tree to analyze its miniature registries)"
+        ),
+    )
     args = ap.parse_args(argv)
 
+    root = (
+        (args.root if args.root.is_absolute() else REPO_ROOT / args.root)
+        if args.root is not None
+        else REPO_ROOT
+    )
     targets = [
-        p if p.is_absolute() else REPO_ROOT / p
+        p if p.is_absolute() else root / p
         for p in (pathlib.Path(s) for s in args.paths)
     ]
     rule_ids = args.rules.split(",") if args.rules else None
-    findings, n_files = check_paths(targets, REPO_ROOT, rule_ids)
+    findings, n_files = check_paths(
+        targets,
+        root,
+        rule_ids,
+        audit=args.audit_pragmas,
+        pragma_budget=load_pragma_budget(args.baseline),
+    )
 
     if args.write_baseline:
         write_baseline(findings, args.baseline)
@@ -82,13 +203,15 @@ def main(argv=None) -> int:
     baseline = {} if args.no_baseline else load_baseline(args.baseline)
     fresh, old = split_baselined(findings, baseline)
 
+    fmt = "json" if args.json else args.format
     summary = {
         "files": n_files,
         "findings": len(fresh),
         "baselined": len(old),
+        "audit": bool(args.audit_pragmas),
         "rules": sorted(registered_rules()),
     }
-    if args.json:
+    if fmt == "json":
         print(
             json.dumps(
                 {
@@ -99,6 +222,9 @@ def main(argv=None) -> int:
                 indent=2,
             )
         )
+        return 1 if fresh else 0
+    if fmt == "sarif":
+        print(json.dumps(to_sarif(fresh, old), indent=2))
         return 1 if fresh else 0
     return report(
         "staticcheck",

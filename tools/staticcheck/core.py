@@ -2,26 +2,36 @@
 
 Design (mirrors go vet / staticcheck-style gates, stdlib-only):
 
-- A *rule* is a class with an ``id``, a ``doc`` line and a
-  ``check(ctx)`` generator yielding Findings; it registers itself via
-  the ``@rule`` decorator (tools/staticcheck/rules.py holds the
-  catalog).
+- A *rule* is a class with an ``id``, a ``doc`` line and either a
+  per-file ``check(ctx)`` generator or a whole-program
+  ``check_program(index, ctx_map)`` generator yielding Findings; it
+  registers itself via the ``@rule`` decorator
+  (tools/staticcheck/rules.py and tools/staticcheck/registry_rules.py
+  hold the catalog; tools/staticcheck/program.py builds the
+  cross-module index the program rules run over).
 - *Pragmas* suppress findings at the source: a trailing
-  ``# staticcheck: allow[RULE] justification`` suppresses that RULE on
-  that line; ``# staticcheck: allow-file[RULE] justification`` (its
-  own line) suppresses the rule for the whole file.  A pragma WITHOUT
-  a justification is itself a finding (PRAGMA001) and suppresses
-  nothing — every sanctioned exception must say why.
+  ``staticcheck: allow[<RULE>] <why>`` comment suppresses that RULE
+  on that line; ``staticcheck: allow-file[<RULE>] <why>`` (its own
+  line) suppresses the rule for the whole file.  A pragma WITHOUT a
+  justification is itself a finding (PRAGMA001) and suppresses
+  nothing — every sanctioned exception must say why.  Audit mode
+  (``--audit-pragmas``) additionally re-runs all rules UNSUPPRESSED
+  and reports every pragma that no longer suppresses anything
+  (PRAGMA002) plus any growth of the pragma population past the
+  budget recorded in the baseline file (PRAGMA003).
 - The *baseline* (tools/staticcheck/baseline.json) grandfathers known
   findings so the gate can land before the tree is fully clean.  Keys
   are (rule, path, source-line-text) — stable across unrelated line
   drift.  The merged tree's baseline is EMPTY: every finding is fixed
-  or pragma'd.
+  or pragma'd.  The same file carries ``pragma_budget``, the audit
+  cap on the tree's pragma count.
 - Scoping is path-derived: FileContext computes ``in_plane`` (any of
   protocol/, core/, ops/ in the path — the determinism plane) and
   ``in_transport``; each rule reads the flags it cares about.  The
   fixture corpus under tests/staticcheck_fixtures/ reuses exactly this
-  mechanism by nesting fixtures in protocol/ / transport/ dirs.
+  mechanism by nesting fixtures in protocol/ / transport/ dirs; tree
+  walks skip that corpus (it is test DATA, scanned only when targeted
+  directly).
 """
 
 from __future__ import annotations
@@ -168,10 +178,14 @@ class Pragmas:
         line_allows: Dict[int, frozenset],
         file_allows: frozenset,
         bad: List[Finding],
+        entries: Optional[List[Tuple[int, str, frozenset]]] = None,
     ) -> None:
         self.line_allows = line_allows
         self.file_allows = file_allows
         self.bad = bad  # PRAGMA001 findings (missing justification)
+        # every well-formed pragma as (line, kind, rules): the audit
+        # mode's raw material (PRAGMA002/PRAGMA003)
+        self.entries = entries if entries is not None else []
 
     def suppresses(self, f: Finding) -> bool:
         if f.rule in self.file_allows:
@@ -183,6 +197,7 @@ def parse_pragmas(ctx: FileContext) -> Pragmas:
     line_allows: Dict[int, frozenset] = {}
     file_allows: set = set()
     bad: List[Finding] = []
+    entries: List[Tuple[int, str, frozenset]] = []
     for i, line in enumerate(ctx.lines, 1):
         m = _PRAGMA_RE.search(line)
         if m is None:
@@ -206,11 +221,12 @@ def parse_pragmas(ctx: FileContext) -> Pragmas:
                 )
             )
             continue
+        entries.append((i, kind, rules))
         if kind == "allow-file":
             file_allows |= rules
         else:
             line_allows[i] = line_allows.get(i, frozenset()) | rules
-    return Pragmas(line_allows, frozenset(file_allows), bad)
+    return Pragmas(line_allows, frozenset(file_allows), bad, entries)
 
 
 # ---------------------------------------------------------------------------
@@ -224,6 +240,18 @@ def load_baseline(path: pathlib.Path = BASELINE_PATH) -> Dict[str, int]:
         return {}
     data = json.loads(path.read_text(encoding="utf-8"))
     return {str(k): int(v) for k, v in data.get("findings", {}).items()}
+
+
+def load_pragma_budget(
+    path: pathlib.Path = BASELINE_PATH,
+) -> Optional[int]:
+    """The audit cap on the tree's pragma count; None = no cap
+    recorded (audit then only checks staleness)."""
+    if not path.exists():
+        return None
+    data = json.loads(path.read_text(encoding="utf-8"))
+    budget = data.get("pragma_budget")
+    return int(budget) if budget is not None else None
 
 
 def write_baseline(
@@ -264,51 +292,206 @@ def split_baselined(
 # ---------------------------------------------------------------------------
 
 
+FIXTURE_DIR_NAME = "staticcheck_fixtures"
+
+
+def _load_contexts(
+    paths: Iterable[pathlib.Path], root: pathlib.Path
+) -> Tuple[List[FileContext], List[Finding], int]:
+    """(parsed contexts, PARSE findings, files seen).  Tree walks skip
+    the fixture corpus — it is test DATA full of deliberate findings —
+    unless a target points inside it."""
+    ctxs: List[FileContext] = []
+    parse_findings: List[Finding] = []
+    n_files = 0
+    seen: set = set()
+    for target in paths:
+        include_fixtures = FIXTURE_DIR_NAME in target.parts
+        for py in walk_python_files(target):
+            if (
+                not include_fixtures
+                and FIXTURE_DIR_NAME in py.parts
+            ):
+                continue
+            key = str(py.resolve())
+            if key in seen:
+                continue
+            seen.add(key)
+            n_files += 1
+            try:
+                ctxs.append(FileContext(py, root))
+            except SyntaxError as e:
+                # the format gate owns syntax; surface it here too so
+                # a staticcheck run never crashes on a broken file
+                parse_findings.append(
+                    Finding(
+                        rule="PARSE",
+                        path=rel_posix(py, root),
+                        line=e.lineno or 1,
+                        col=e.offset or 0,
+                        message=f"does not parse: {e.msg}",
+                    )
+                )
+    return ctxs, parse_findings, n_files
+
+
+def _run_rules(
+    ctxs: List[FileContext],
+    root: pathlib.Path,
+    rule_ids: Optional[Iterable[str]] = None,
+) -> List[Finding]:
+    """Every raw (UNsuppressed, non-pragma) finding: per-file rules
+    over each context plus registry rules over the two-pass index."""
+    from tools.staticcheck.program import build_index
+
+    wanted = set(rule_ids) if rule_ids is not None else None
+    out: List[Finding] = []
+    for ctx in ctxs:
+        for rid, r in _RULES.items():
+            if wanted is not None and rid not in wanted:
+                continue
+            check = getattr(r, "check", None)
+            if check is not None:
+                out.extend(check(ctx))
+    ctx_map = {ctx.relpath: ctx for ctx in ctxs}
+    index = build_index(ctxs, root)
+    for rid, r in _RULES.items():
+        if wanted is not None and rid not in wanted:
+            continue
+        check_program = getattr(r, "check_program", None)
+        if check_program is not None:
+            out.extend(check_program(index, ctx_map))
+    return out
+
+
+def _suppress(
+    findings: List[Finding], pragmas_by_path: Dict[str, Pragmas]
+) -> List[Finding]:
+    out: List[Finding] = []
+    for f in findings:
+        p = pragmas_by_path.get(f.path)
+        if p is not None and p.suppresses(f):
+            continue
+        out.append(f)
+    return out
+
+
+def audit_pragmas(
+    raw: List[Finding],
+    pragmas_by_path: Dict[str, Pragmas],
+    ctx_map: Dict[str, FileContext],
+    budget: Optional[int],
+) -> List[Finding]:
+    """PRAGMA002 for every pragma that suppresses nothing in the raw
+    (unsuppressed) findings; PRAGMA003 for every pragma past the
+    population budget, counted in (path, line) order — a
+    deterministic anchor for the overflow, not an attribution of
+    which pragma was added last (the message carries the count and
+    the budget; the fix is to shed any pragma or bump the budget in
+    review)."""
+    by_file_rules: Dict[str, set] = {}
+    by_line_rules: Dict[Tuple[str, int], set] = {}
+    for f in raw:
+        by_file_rules.setdefault(f.path, set()).add(f.rule)
+        by_line_rules.setdefault((f.path, f.line), set()).add(f.rule)
+    out: List[Finding] = []
+    all_entries: List[Tuple[str, int, str, frozenset]] = []
+    for path in sorted(pragmas_by_path):
+        for line, kind, rules in pragmas_by_path[path].entries:
+            all_entries.append((path, line, kind, rules))
+    for path, line, kind, rules in all_entries:
+        if kind == "allow-file":
+            live = by_file_rules.get(path, set())
+        else:
+            live = by_line_rules.get((path, line), set())
+        stale = sorted(rules - live)
+        if stale:
+            ctx = ctx_map.get(path)
+            out.append(
+                Finding(
+                    rule="PRAGMA002",
+                    path=path,
+                    line=line,
+                    col=0,
+                    message=(
+                        f"stale pragma: {kind}[{','.join(stale)}] "
+                        "suppresses nothing here any more; delete it "
+                        "(or fix the rule scope it expected)"
+                    ),
+                    snippet=ctx.source_line(line) if ctx else "",
+                )
+            )
+    if budget is not None and len(all_entries) > budget:
+        for path, line, kind, rules in all_entries[budget:]:
+            ctx = ctx_map.get(path)
+            out.append(
+                Finding(
+                    rule="PRAGMA003",
+                    path=path,
+                    line=line,
+                    col=0,
+                    message=(
+                        f"pragma population {len(all_entries)} "
+                        f"exceeds the audited budget {budget} "
+                        "(tools/staticcheck/baseline.json "
+                        "pragma_budget); fix the finding instead, or "
+                        "raise the budget deliberately in review"
+                    ),
+                    snippet=ctx.source_line(line) if ctx else "",
+                )
+            )
+    return out
+
+
 def check_file(
     path: pathlib.Path,
     root: pathlib.Path = REPO_ROOT,
     rule_ids: Optional[Iterable[str]] = None,
 ) -> List[Finding]:
-    """All (pragma-filtered) findings for one file, line-ordered."""
-    try:
-        ctx = FileContext(path, root)
-    except SyntaxError as e:
-        # the format gate owns syntax; surface it here too so a
-        # standalone staticcheck run never crashes on a broken file
-        return [
-            Finding(
-                rule="PARSE",
-                path=rel_posix(path, root),
-                line=e.lineno or 1,
-                col=e.offset or 0,
-                message=f"does not parse: {e.msg}",
-            )
-        ]
-    pragmas = parse_pragmas(ctx)
-    wanted = set(rule_ids) if rule_ids is not None else None
-    out: List[Finding] = list(pragmas.bad)
-    for rid, r in _RULES.items():
-        if wanted is not None and rid not in wanted:
-            continue
-        for f in r.check(ctx):
-            if not pragmas.suppresses(f):
-                out.append(f)
-    out.sort(key=lambda f: (f.line, f.col, f.rule))
-    return out
+    """All (pragma-filtered) findings for one file, line-ordered.
+    Registry rules see a single-file index, so self-contained fixture
+    registries gate here too."""
+    findings, _n = check_paths([path], root, rule_ids)
+    findings.sort(key=lambda f: (f.line, f.col, f.rule))
+    return findings
 
 
 def check_paths(
     paths: Iterable[pathlib.Path],
     root: pathlib.Path = REPO_ROOT,
     rule_ids: Optional[Iterable[str]] = None,
+    audit: bool = False,
+    pragma_budget: Optional[int] = None,
 ) -> Tuple[List[Finding], int]:
-    """(findings, files_scanned) across every .py under ``paths``."""
-    findings: List[Finding] = []
-    n_files = 0
-    for target in paths:
-        for py in walk_python_files(target):
-            n_files += 1
-            findings.extend(check_file(py, root, rule_ids))
+    """(findings, files_scanned) across every .py under ``paths``.
+
+    Pass 1 parses every file and builds the cross-module registry
+    index; pass 2 runs the per-file and whole-program rules, then
+    applies pragma suppression.  ``audit=True`` additionally reports
+    stale pragmas (PRAGMA002) and budget overruns (PRAGMA003)."""
+    ctxs, parse_findings, n_files = _load_contexts(paths, root)
+    pragmas_by_path = {
+        ctx.relpath: parse_pragmas(ctx) for ctx in ctxs
+    }
+    raw = _run_rules(ctxs, root, rule_ids)
+    findings: List[Finding] = list(parse_findings)
+    for p in pragmas_by_path.values():
+        findings.extend(p.bad)
+    findings.extend(_suppress(raw, pragmas_by_path))
+    if audit:
+        ctx_map = {ctx.relpath: ctx for ctx in ctxs}
+        # staleness is judged against EVERY rule's raw findings even
+        # when --rules narrowed the report — otherwise a subset run
+        # declares every other rule's pragmas stale
+        raw_all = (
+            raw if rule_ids is None else _run_rules(ctxs, root, None)
+        )
+        findings.extend(
+            audit_pragmas(
+                raw_all, pragmas_by_path, ctx_map, pragma_budget
+            )
+        )
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings, n_files
 
 
@@ -319,12 +502,15 @@ def _finding_iter(findings: List[Finding]) -> Iterator[str]:
 
 __all__ = [
     "BASELINE_PATH",
+    "FIXTURE_DIR_NAME",
     "FileContext",
     "Finding",
     "Pragmas",
+    "audit_pragmas",
     "check_file",
     "check_paths",
     "load_baseline",
+    "load_pragma_budget",
     "parse_pragmas",
     "registered_rules",
     "rule",

@@ -457,15 +457,16 @@ class Det005RosterVersionAccessor:
 # seam back to one envelope encode + sign pass per post — the exact
 # redundancy the wave signer removed.  The sanctioned sites (the
 # scalar byte-equivalence comparison arm behind
-# Config.egress_columnar=False, pre-pool boot traffic, non-endpoint
-# test rigs, and the wave signer's own per-item defaults in base.py)
-# carry allow[DET006] pragmas with justifications; transport/message.py
-# is the codec itself and exempt.
+# Config.egress_columnar=False and pre-pool boot traffic) carry
+# allow[DET006] pragmas with justifications; transport/message.py is
+# the codec itself and transport/base.py is the authenticator layer
+# whose job IS the per-frame encode+sign primitives (the hub.py of
+# this seam), so both are exempt.
 
 _DET006_CALLS = frozenset(
     ("sign_wire_many", "encode_message", "sign_wire")
 )
-_DET006_EXEMPT_FILES = frozenset(("message.py",))
+_DET006_EXEMPT_FILES = frozenset(("message.py", "base.py"))
 
 
 @rule
