@@ -570,6 +570,7 @@ def measure_order_overlap(
     batch: int = OVERLAP_BATCH,
     epochs: int = OVERLAP_EPOCHS,
     order_then_settle: bool = True,
+    pipeline_depth: int = 1,
 ) -> dict:
     """Chained protocol epochs through the two-frontier commit split:
     transactions pre-submitted, ``auto_propose`` on, ONE ``net.run``
@@ -584,12 +585,20 @@ def measure_order_overlap(
     from cleisthenes_tpu.config import Config
     from cleisthenes_tpu.protocol.cluster import SimulatedCluster
 
+    # the lead must clear depth + the default lag (read off the
+    # dataclass, never a re-stated literal)
+    lag = Config.__dataclass_fields__["decrypt_lag_max"].default
     cfg = Config(
         n=n,
         batch_size=batch,
         crypto_backend=backend,
         seed=99,
         order_then_settle=order_then_settle,
+        # K-deep pipelined frontiers (ISSUE 15): the section sweeps
+        # depth ∈ {1, 2, 4}, so K concurrent epochs share waves and
+        # the per-ordered-epoch dispatch counters below move
+        pipeline_depth=pipeline_depth,
+        reconfig_lead=max(8, pipeline_depth + lag + 1),
     )
     cluster = SimulatedCluster(
         config=cfg, key_seed=77, auto_propose=True, shared_hub=True
@@ -661,12 +670,27 @@ def measure_order_overlap(
         for s0, s1 in settle_iv
         for p0, p1 in merged
     )
+    # K-deep wave-sharing counters (ISSUE 15): cluster-wide hub/router
+    # dispatch totals over the measured run, normalized per ORDERED
+    # epoch — K concurrent epochs landing in the same delivery waves
+    # is exactly a drop in these (the zero-noise evidence rule)
+    ordered_total = max(
+        1,
+        n0.metrics.epochs_ordered.value or n0.settled_epoch,
+    )
+    hub_stats = n0.hub.stats()
+    handler_total = sum(
+        hb.metrics.handler_dispatches.value
+        for hb in cluster.nodes.values()
+    )
+    widths = sorted(n0.hub.wave_widths)
     out = {
         "n": n,
         "batch": batch,
         "mode": (
             "order_then_settle" if order_then_settle else "coupled"
         ),
+        "pipeline_depth": pipeline_depth,
         "measured_epochs": len(spans),
         "elapsed_wall_ms": round(elapsed * 1000.0, 3),
         "serial_epoch_walls_ms": round(serial * 1000.0, 3),
@@ -690,27 +714,80 @@ def measure_order_overlap(
             if spans
             else None
         ),
+        # per-ordered-epoch dispatch amortization (counter-based,
+        # deterministic for the seeded schedule)
+        "hub_dispatches_per_ordered_epoch": round(
+            hub_stats["dispatches"] / ordered_total, 1
+        ),
+        "hub_flushes_per_ordered_epoch": round(
+            hub_stats["flushes"] / ordered_total, 1
+        ),
+        "handler_dispatches_per_ordered_epoch": round(
+            handler_total / ordered_total, 1
+        ),
+        "eager_share_waves": int(
+            sum(
+                hb.metrics.eager_share_waves.value
+                for hb in cluster.nodes.values()
+            )
+        ),
+        "wave_width_p50": (
+            widths[len(widths) // 2] if widths else None
+        ),
+        # same index rule as the protocol sections above, so the key
+        # means the same thing in every section of one report
+        "wave_width_p95": (
+            widths[max(0, int(round(0.95 * (len(widths) - 1))))]
+            if widths
+            else None
+        ),
     }
     out.update(two_frontier_keys(m))
     return out
 
 
 def order_overlap_section(backend: str) -> dict:
-    """Both arms of the same seeded workload: the two-frontier split
-    vs the coupled commit path — paired on one box, back to back."""
-    split = measure_order_overlap(backend, order_then_settle=True)
+    """The same seeded workload across the commit/pipelining arms:
+    the two-frontier split at K-deep window depths 1, 2 and 4
+    (ISSUE 15 — depth 1 is the lockstep comparison arm) vs the
+    coupled commit path — all paired on one box, back to back."""
+    depths = {
+        depth: measure_order_overlap(
+            backend, order_then_settle=True, pipeline_depth=depth
+        )
+        for depth in (1, 2, 4)
+    }
+    split = depths[1]
     coupled = measure_order_overlap(backend, order_then_settle=False)
     return {
         "n": OVERLAP_N,
         "batch": OVERLAP_BATCH,
         "epochs": OVERLAP_EPOCHS,
         "order_then_settle": split,
+        "depth2": depths[2],
+        "depth4": depths[4],
         "coupled": coupled,
         # the headline: settled-throughput ratio of split vs coupled
         # on identical submitted work (elapsed wall, lower is better)
         "split_vs_coupled_wall_x": _vs(
             coupled["elapsed_wall_ms"], split["elapsed_wall_ms"]
         ),
+        # K-deep headlines: overlap and wall ratio per depth vs the
+        # depth-1 arm of the identical workload, plus the wave-width
+        # delta (K epochs sharing waves widens each hub flush)
+        "pipeline_overlap_x_by_depth": {
+            str(d): depths[d]["pipeline_overlap_x"] for d in depths
+        },
+        "depth4_vs_depth1_wall_x": _vs(
+            split["elapsed_wall_ms"], depths[4]["elapsed_wall_ms"]
+        ),
+        "wave_width_p50_by_depth": {
+            str(d): depths[d]["wave_width_p50"] for d in depths
+        },
+        "hub_dispatches_per_ordered_epoch_by_depth": {
+            str(d): depths[d]["hub_dispatches_per_ordered_epoch"]
+            for d in depths
+        },
     }
 
 

@@ -110,6 +110,16 @@ JAX_PLATFORMS=cpu python -m tools.fuzz --seeds 0:20 --out "$FUZZ_OUT"
 # tests/test_fuzz.py::test_fuzz_reconfig_deep_sweep)
 JAX_PLATFORMS=cpu python -m tools.fuzz --seeds 0:20 --reconfig \
     --rounds 16 --out "$FUZZ_OUT"
+# K-deep pipelined-frontier band (ISSUE 15): the same composite
+# schedules PINNED to depth 2 and depth 4 — the cross-frontier
+# invariants (settled prefix ⊆ ordered log, byte-identical honest
+# ordered logs, decrypt-lag bound) must hold over the widened
+# in-flight window (the 200-seed deep sweep rides the slow tier,
+# tests/test_fuzz.py::test_fuzz_pipeline_deep_sweep)
+JAX_PLATFORMS=cpu python -m tools.fuzz --seeds 0:10 \
+    --pipeline-depth 2 --out "$FUZZ_OUT"
+JAX_PLATFORMS=cpu python -m tools.fuzz --seeds 10:20 \
+    --pipeline-depth 4 --out "$FUZZ_OUT"
 rm -rf "$FUZZ_OUT"
 
 if [[ "${CI_FAST:-0}" == "1" ]]; then

@@ -37,6 +37,13 @@ ARM_FLAGS = (
 )
 
 DEFAULT_DIAL_TIMEOUT_S = 3.0  # reference comm.go:107-109
+# K-deep pipelined frontiers (Config.pipeline_depth): the protocol
+# plane may run at most this many epochs' RBC/BBA concurrently.  The
+# cap is the demux window's forward horizon
+# (protocol.honeybadger.EPOCH_HORIZON, cross-checked there): an
+# in-flight epoch past the horizon could not be delivered to a peer
+# at the same frontier.
+MAX_PIPELINE_DEPTH = 8
 DEFAULT_CHANNEL_CAPACITY = 200  # reference conn.go:60-61 (out/read chans)
 # Self-healing dial layer (transport/host.py): first retry delay and
 # the cap of the exponential backoff.  The reference redials never
@@ -114,14 +121,25 @@ class Config:
       order_then_settle: two-frontier commit split (see the field
         comment below): ciphertext-ordered commit at ACS output, with
         threshold decryption trailing in an idle-driven settler.
+      pipeline_depth: K-deep pipelined frontiers (see the field
+        comment below): epochs [ordered frontier, ordered frontier +
+        K - 1] run their RBC propose/ECHO/READY and BBA rounds
+        concurrently; ordering still advances strictly in epoch
+        order and parks at decrypt_lag_max.  1 (lockstep — only the
+        frontier epoch runs, today's pre-K behavior byte-identically)
+        .. MAX_PIPELINE_DEPTH (the demux window's forward horizon).
+        Effective only on the pipelined two-frontier path
+        (epoch_pipelining and order_then_settle both on — the
+        epoch_pipelining arm flag gates the whole K-deep plane).
       decrypt_lag_max: backpressure bound on ordered-ahead epochs
         (ordered frontier - settled frontier); also the settle-stall
         SLO watchdog's lag budget.
       reconfig_lead: dynamic membership (protocol.reconfig): epochs
         between the settlement completing a reshare ceremony and the
-        new roster's activation; must exceed decrypt_lag_max so the
-        activation boundary lands past every epoch the old roster
-        could already have ordered.
+        new roster's activation; must exceed pipeline_depth +
+        decrypt_lag_max so the activation boundary lands past every
+        epoch the old roster could already have ordered OR still
+        have in flight in the K-deep window.
       delivery_columnar: columnar inbound delivery plane — wave-batched
         MAC verification + shared-prefix frame-decode memoization on
         both transports (see the field comment below).  False is the
@@ -236,6 +254,19 @@ class Config:
     # (seeded runs must commit byte-identical ledgers under either
     # arm; tests/test_egress_equivalence.py).
     egress_columnar: bool = True
+    # K-deep pipelined epoch frontiers (ISSUE 15, the PR-8 split
+    # generalized): epochs [self.epoch, self.epoch + K - 1] run their
+    # RBC/BBA concurrently against the K-deep ordered window, each
+    # with its own _EpochState — K concurrent epochs' traffic lands
+    # in the SAME delivery waves, so the hub/router/egress columnar
+    # planes amortize K epochs' crypto into one dispatch per kind per
+    # wave.  Ordering still advances strictly in epoch order
+    # (_maybe_order) and parks at decrypt_lag_max exactly as at depth
+    # 1.  Depth 1 reproduces the pre-K behavior byte-identically and
+    # stays live as the comparison arm (tests/test_pipeline_depth.py);
+    # the plane as a whole is gated by the epoch_pipelining ARM flag
+    # (epoch_pipelining=False forces lockstep regardless of depth).
+    pipeline_depth: int = 2
     # Bounded ordered-but-unsettled window: the ordered frontier may
     # run at most this many epochs ahead of the settled frontier
     # before ordering parks (backpressure).  A Byzantine coalition
@@ -306,12 +337,27 @@ class Config:
                 f"decrypt_lag_max={self.decrypt_lag_max} must be >= 1 "
                 "(1 = order at most one epoch ahead of settlement)"
             )
-        if self.reconfig_lead <= self.decrypt_lag_max:
+        if self.pipeline_depth < 1:
+            raise ValueError(
+                f"pipeline_depth={self.pipeline_depth} must be >= 1 "
+                "(1 = lockstep: only the ordered frontier's epoch "
+                "runs its RBC/BBA)"
+            )
+        if self.pipeline_depth > MAX_PIPELINE_DEPTH:
+            raise ValueError(
+                f"pipeline_depth={self.pipeline_depth} exceeds "
+                f"MAX_PIPELINE_DEPTH={MAX_PIPELINE_DEPTH} (the demux "
+                "window's forward horizon: an in-flight epoch past it "
+                "could not reach a same-frontier peer)"
+            )
+        if self.reconfig_lead <= self.pipeline_depth + self.decrypt_lag_max:
             raise ValueError(
                 f"reconfig_lead={self.reconfig_lead} must exceed "
-                f"decrypt_lag_max={self.decrypt_lag_max} (the roster "
-                "switch point must land past every epoch the old "
-                "roster could already have ordered)"
+                f"pipeline_depth + decrypt_lag_max = "
+                f"{self.pipeline_depth + self.decrypt_lag_max} (the "
+                "roster switch point must land past every epoch the "
+                "old roster could already have ordered or still have "
+                "in flight in the K-deep window)"
             )
         if self.mesh_shape is not None:
             from cleisthenes_tpu.parallel.mesh import validate_mesh_shape

@@ -1026,6 +1026,18 @@ class Tpke:
     ) -> DhShare:
         return issue_share(share, ct.c1, self._context(ct), self.group)
 
+    def dec_share_items(
+        self, share: ThresholdSecretShare, cts: Sequence[Ciphertext]
+    ) -> List[tuple]:
+        """The ``(share, base, context, vk)`` rows
+        ``issue_shares_batch`` takes for this key set — the ONE place
+        the CP-proof context/vk binding is built, shared by
+        ``dec_share_batch`` and the CryptoHub's eager dec-share
+        column (K-deep pipelining) so the two issue paths can never
+        bind different contexts."""
+        vk = self.pub.verification_keys[share.index - 1]
+        return [(share, ct.c1, self._context(ct), vk) for ct in cts]
+
     def dec_share_batch(
         self, share: ThresholdSecretShare, cts: Sequence[Ciphertext]
     ) -> List[DhShare]:
@@ -1036,9 +1048,8 @@ class Tpke:
         was N 4-exp calls + N urandom reads per node per epoch)."""
         if not cts:
             return []
-        vk = self.pub.verification_keys[share.index - 1]
         return issue_shares_batch(
-            [(share, ct.c1, self._context(ct), vk) for ct in cts],
+            self.dec_share_items(share, cts),
             group=self.group,
             backend=self.backend,
             mesh=self.mesh,

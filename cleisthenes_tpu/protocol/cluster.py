@@ -208,7 +208,7 @@ class SimulatedCluster:
         for nid in self.ids:
             wd = SloWatchdog(
                 metrics=self.nodes[nid].metrics,
-                pending_fn=self.nodes[nid].pending_tx_count,
+                pending_fn=self.nodes[nid].outstanding_tx_count,
                 stall_factor=self.config.slo_stall_factor,
                 stall_grace_s=self.config.slo_stall_grace_s,
                 queue_depth_limit=self.config.slo_queue_depth,
@@ -359,7 +359,7 @@ class SimulatedCluster:
 
         wd = SloWatchdog(
             metrics=hb.metrics,
-            pending_fn=hb.pending_tx_count,
+            pending_fn=hb.outstanding_tx_count,
             stall_factor=self.config.slo_stall_factor,
             stall_grace_s=self.config.slo_stall_grace_s,
             queue_depth_limit=self.config.slo_queue_depth,
@@ -534,7 +534,7 @@ class SimulatedCluster:
             self.ids.sort()
         wd = SloWatchdog(
             metrics=hb.metrics,
-            pending_fn=hb.pending_tx_count,
+            pending_fn=hb.outstanding_tx_count,
             stall_factor=self.config.slo_stall_factor,
             stall_grace_s=self.config.slo_stall_grace_s,
             queue_depth_limit=self.config.slo_queue_depth,

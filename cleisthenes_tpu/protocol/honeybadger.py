@@ -32,7 +32,7 @@ import hashlib
 import struct
 from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
 
-from cleisthenes_tpu.config import Config
+from cleisthenes_tpu.config import MAX_PIPELINE_DEPTH, Config
 from cleisthenes_tpu.core.batch import Batch
 from cleisthenes_tpu.core.ledger import (
     decode_batch_body,
@@ -82,6 +82,11 @@ from cleisthenes_tpu.transport.message import (
 # lagging peers, and how far ahead a fast peer may pull us.
 KEEP_BEHIND = 2
 EPOCH_HORIZON = 8
+# the K-deep pipeline window (Config.pipeline_depth) must fit the
+# demux window's forward horizon, or an in-flight epoch's traffic
+# could not reach a same-frontier peer (Config validates depth
+# against MAX_PIPELINE_DEPTH; this pins the two constants together)
+assert MAX_PIPELINE_DEPTH <= EPOCH_HORIZON
 # epochs of committed-tx memory for lazy duplicate filtering
 COMMITTED_MEMORY_EPOCHS = 64
 # CATCHUP serving cap: epochs one CatchupReq answers with (the
@@ -591,8 +596,31 @@ class HoneyBadger:
         # one-instant-per-parked-epoch trace dedup
         self._settler_active = False
         self._park_traced = -1
+        # K-deep pipelined frontiers (Config.pipeline_depth): the
+        # window-top-up drive's reentrancy guard (proposing runs the
+        # RBC propose path, whose turn exit would recurse back here)
+        # and the eager dec-share flag — True while this node has
+        # issue work staged in the hub's dec-share column awaiting
+        # the turn's piggyback drain (_drain_dec_issues)
+        self._pipeline_active = False
+        self._eager_staged = False
         self.metrics.set_frontiers(
             lambda: (self.epoch, len(self.committed_batches))
+        )
+        self.metrics.set_pipeline(
+            # read from observability threads (ValidatorHost sampler):
+            # list() snapshots the dict against concurrent protocol-
+            # thread mutation; ``not committed`` keeps the coupled
+            # arm honest (it never sets es.ordered, and committed
+            # epochs linger within KEEP_BEHIND of the frontier)
+            lambda: sum(
+                1
+                for s in list(self._epochs.values())
+                if s.proposed
+                and s.acs is not None
+                and not s.ordered
+                and not s.committed
+            )
         )
         # production: unpredictable sampling (censorship resistance);
         # seeded: reproducible for tests (config.seed docs).  The
@@ -769,36 +797,135 @@ class HoneyBadger:
 
         ``epoch`` defaults to the commit frontier; the pipelining path
         passes ``self.epoch + 1`` to propose ahead (BASELINE config 5).
+        A frontier-default call (``epoch=None`` — the external kick)
+        additionally tops up the K-deep in-flight window
+        (Config.pipeline_depth; no-op at depth 1).
         """
         try:
-            target = self.epoch if epoch is None else epoch
-            es = self._epoch_state(target)
-            if es is None or es.proposed:
-                return
-            es.proposed = True
-            self.metrics.epoch_proposed(target)
-            tr = self.trace
-            if tr is not None:
+            if epoch is None:
+                self._propose_into(self.epoch)
+                self._drive_pipeline()
+            else:
+                self._propose_into(epoch)
+        finally:
+            self._exit_turn()
+
+    def _propose_into(self, target: int) -> None:
+        """One epoch's proposal (the historical start_epoch body):
+        batch select, TPKE encrypt, ACS input.  Callers propose in
+        ascending epoch order — the per-node proposal RNG is a
+        stream, so the draw order is part of the deterministic
+        schedule (K-deep runs must consume it exactly like depth 1)."""
+        es = self._epoch_state(target)
+        if es is None or es.proposed:
+            return
+        es.proposed = True
+        self.metrics.epoch_proposed(target)
+        tr = self.trace
+        if tr is not None:
+            ahead = target - self.epoch
+            if ahead > 0:  # K-deep window position; frontier opens
+                tr.instant("epoch", "open", epoch=target, ahead=ahead)
+            else:  # keep the depth-1 event byte-stable
                 tr.instant("epoch", "open", epoch=target)
-            t0 = 0.0 if tr is None else tr.now()
-            es.my_txs = self._create_batch()
-            # the EPOCH's key set (an epoch past an activation
-            # boundary encrypts under the reshared key even while the
-            # proposer's active roster is still the old one)
-            view = es.view
-            ct = view.tpke.encrypt(serialize_txs(es.my_txs))
-            if tr is not None:
-                tr.complete(
-                    "tpke", "encrypt", t0, epoch=target, txs=len(es.my_txs)
-                )
-            es.acs.input(
-                serialize_ciphertext(ct, view.keys.tpke_pub.group)
+        t0 = 0.0 if tr is None else tr.now()
+        es.my_txs = self._create_batch()
+        # the EPOCH's key set (an epoch past an activation
+        # boundary encrypts under the reshared key even while the
+        # proposer's active roster is still the old one)
+        view = es.view
+        ct = view.tpke.encrypt(serialize_txs(es.my_txs))
+        if tr is not None:
+            tr.complete(
+                "tpke", "encrypt", t0, epoch=target, txs=len(es.my_txs)
             )
+        es.acs.input(
+            serialize_ciphertext(ct, view.keys.tpke_pub.group)
+        )
+
+    @property
+    def _pipeline_depth(self) -> int:
+        """The K-deep protocol-plane window width: epochs
+        [self.epoch, self.epoch + K - 1] may run RBC/BBA
+        concurrently.  Depth is an ordered-frontier concept, so it
+        collapses to 1 (lockstep) whenever the two-frontier split is
+        off — the epoch_pipelining ARM flag gates the whole plane."""
+        return self.config.pipeline_depth if self._two_frontier else 1
+
+    def _drive_pipeline(self) -> None:
+        """Top up the K-deep in-flight window (Config.pipeline_depth):
+        propose into epochs [self.epoch + 1, self.epoch + K - 1] so
+        their RBC/BBA runs concurrently with the frontier epoch's,
+        while ordering itself still advances strictly in epoch order
+        (_maybe_order) and parks at decrypt_lag_max.  Per-epoch
+        propose rule matches _advance_epoch's: local work pending, or
+        the epoch already live from peer traffic.  Ascending order
+        (the proposal-RNG stream rule, see _propose_into).  No-op at
+        depth 1 — the byte-identical comparison arm."""
+        depth = self._pipeline_depth
+        if (
+            depth <= 1
+            or self._pipeline_active
+            or not self.auto_propose
+            or self._retired_self
+        ):
+            return
+        self._pipeline_active = True
+        try:
+            for e in range(self.epoch + 1, self.epoch + depth):
+                es = self._epochs.get(e)
+                if es is not None and es.proposed:
+                    continue
+                if len(self.que) > 0 or es is not None:
+                    self._propose_into(e)
+        finally:
+            self._pipeline_active = False
+
+    def maybe_follow_epoch(self, epoch: int, es: _EpochState) -> None:
+        """Follow-the-epoch — THE shared rule of both routing arms
+        (the scalar `_serve_payload` chain and the WaveRouter call
+        here, so the arms' follow windows can never drift apart):
+        peer traffic showed an epoch inside our pipeline window
+        [self.epoch, self.epoch + depth - 1] running without our
+        proposal — contribute it (every correct node must propose or
+        ACS never reaches n-f ones).  Any unproposed epochs BELOW it
+        propose first: the K-deep window admits traffic for
+        self.epoch + k before self.epoch's own proposal, and the
+        proposal-RNG stream must still be consumed in epoch order.
+        The turn exit mirrors the historical start_epoch() call here,
+        so the depth-1 flush schedule stays byte-identical."""
+        if not (
+            self.auto_propose
+            and self.epoch <= epoch < self.epoch + self._pipeline_depth
+            and not es.proposed
+        ):
+            return
+        try:
+            for e in range(self.epoch, epoch + 1):
+                st = self._epochs.get(e)
+                if st is None or not st.proposed:
+                    self._propose_into(e)
         finally:
             self._exit_turn()
 
     def pending_tx_count(self) -> int:
         return len(self.que)
+
+    def outstanding_tx_count(self) -> int:
+        """Queue depth PLUS transactions absorbed into in-flight
+        (proposed but not yet committed/settled) epochs' own
+        proposals — the work-outstanding signal the SLO stall
+        watchdog reads.  The K-deep pipeline window can drain the
+        whole queue into its in-flight epochs' ``my_txs``, and a
+        stalled node must still read as holding pending work.
+        Called from observability threads (the SLO watchdog's
+        pending_fn): list() snapshots the dict against concurrent
+        protocol-thread mutation."""
+        return len(self.que) + sum(
+            len(es.my_txs)
+            for es in list(self._epochs.values())
+            if es.proposed and not es.committed
+        )
 
     @property
     def _two_frontier(self) -> bool:
@@ -1141,12 +1268,18 @@ class HoneyBadger:
         # hub flush so any CP-verification work it requests rides this
         # wave's batched dispatch, not the next one's.
         self._drive_settler()
+        # top up the K-deep in-flight window before the hub flush:
+        # fresh proposals' RBC traffic joins this turn's bundle
+        self._drive_pipeline()
         self.hub.run_deferred()
         # the flush itself can advance rounds and queue NEW coin
         # issues (coin reveal -> advance -> next round's aux quorum);
         # drain again so they ride this turn's bundle, not the next
         # inbound message's
         self._drain_coin_issues()
+        # eagerly staged dec shares (epochs ordered during this wave,
+        # including inside run_deferred) piggyback on this flush
+        self._drain_dec_issues()
         self._coalesce.flush()
 
     def _exit_turn(self) -> None:
@@ -1156,6 +1289,8 @@ class HoneyBadger:
         if not self._transport_managed:
             self._drain_coin_issues()
             self._drive_settler()
+            self._drive_pipeline()
+            self._drain_dec_issues()
             self._coalesce.flush()
 
     def _queue_coin_issue(self, bba, rnd: int) -> None:
@@ -1337,14 +1472,10 @@ class HoneyBadger:
                 # stale by definition, only dec shares still matter
                 return
             # follow the epoch: a peer is running it, so contribute our
-            # (possibly empty) proposal too — every correct node must
-            # propose or ACS never reaches n-f ones
-            if (
-                self.auto_propose
-                and epoch == self.epoch
-                and not es.proposed
-            ):
-                self.start_epoch()
+            # (possibly empty) proposal too (the shared rule of both
+            # routing arms — window and RNG-order discipline live in
+            # maybe_follow_epoch)
+            self.maybe_follow_epoch(epoch, es)
             self.metrics.handler_dispatches.inc()
             if cls is BbaBatchPayload:
                 es.acs.handle_bba_batch(sender_id, payload)
@@ -1460,8 +1591,43 @@ class HoneyBadger:
         )
         tr = self.trace
         t_share0 = 0.0 if tr is None else tr.now()
-        issue_cts = []
-        issue_proposers = []
+        issue_cts, issue_proposers = self._parse_output_cts(
+            es, local_share
+        )
+        if not local_share:
+            # no threshold share under this epoch's roster (a joiner
+            # bootstrapping, or an adopted ordering from before our
+            # membership): the plaintext arrives via peers' shares or
+            # CLOG catch-up — nothing to issue
+            return
+        dec_shares = view.tpke.dec_share_batch(
+            view.keys.tpke_share, issue_cts
+        )
+        self._broadcast_dec_shares(epoch, issue_proposers, dec_shares)
+        if tr is not None:
+            tr.complete(
+                # the settler runs this off the ordered critical path
+                # in two-frontier mode: its mass belongs to the settle
+                # track, not the open->ordered window's tpke share
+                "settle" if self._two_frontier else "tpke",
+                "dec_share_issue",
+                t_share0,
+                epoch=epoch,
+                ciphertexts=len(es.ciphertexts),
+            )
+
+    def _parse_output_cts(
+        self, es: _EpochState, local_share: bool
+    ) -> Tuple[List[Ciphertext], List[str]]:
+        """Parse the agreed ciphertexts out of ``es.output`` into
+        ``es.ciphertexts`` (junk -> the deterministic-exclusion path
+        every correct node takes identically); returns the fresh
+        (cts, proposers) still needing this node's decryption share —
+        shared by the settler's issue path and the K-deep eager
+        staging path."""
+        view = es.view
+        issue_cts: List[Ciphertext] = []
+        issue_proposers: List[str] = []
         for proposer, ct_bytes in es.output.items():
             if proposer in es.ciphertexts or proposer in es.decrypted:
                 continue
@@ -1479,16 +1645,12 @@ class HoneyBadger:
             es.ciphertexts[proposer] = ct
             issue_cts.append(ct)
             issue_proposers.append(proposer)
-        if not local_share:
-            # no threshold share under this epoch's roster (a joiner
-            # bootstrapping, or an adopted ordering from before our
-            # membership): the plaintext arrives via peers' shares or
-            # CLOG catch-up — nothing to issue
-            return
-        dec_shares = view.tpke.dec_share_batch(
-            view.keys.tpke_share, issue_cts
-        )
-        for proposer, share in zip(issue_proposers, dec_shares):
+        return issue_cts, issue_proposers
+
+    def _broadcast_dec_shares(
+        self, epoch: int, proposers: Sequence[str], shares
+    ) -> None:
+        for proposer, share in zip(proposers, shares):
             self.out.broadcast(
                 DecSharePayload(
                     proposer=proposer,
@@ -1499,17 +1661,74 @@ class HoneyBadger:
                     z=share.z,
                 )
             )
-        if tr is not None:
-            tr.complete(
-                # the settler runs this off the ordered critical path
-                # in two-frontier mode: its mass belongs to the settle
-                # track, not the open->ordered window's tpke share
-                "settle" if self._two_frontier else "tpke",
-                "dec_share_issue",
-                t_share0,
-                epoch=epoch,
-                ciphertexts=len(es.ciphertexts),
+
+    def _stage_eager_dec_shares(
+        self, epoch: int, es: _EpochState
+    ) -> None:
+        """Eager dec-share piggybacking (K-deep mode only): ordering
+        lands mid-wave — often inside the hub flush, AFTER this
+        wave's settler pass already ran — so the classic path would
+        park the freshly ordered epoch's dec shares until the NEXT
+        wave's idle pass.  Instead, stage the issue work into the
+        hub's dec-share column NOW: the first taker of the wave
+        executes every staged owner's items in one batched
+        exponentiation (ops.tpke.issue_shares_batch — one dispatch
+        and one CP-nonce draw for all K epochs and, on a shared-hub
+        cluster, all nodes the wave ordered through), and
+        _drain_dec_issues broadcasts this node's shares before the
+        turn's coalescer flush, so they piggyback on the current
+        wave's outbound bundle instead of waiting a full wave."""
+        if es.shares_issued or es.output is None:
+            return
+        es.shares_issued = True
+        view = es.view
+        local_share = (
+            view.local and view.keys.tpke_share is not None
+        )
+        issue_cts, issue_proposers = self._parse_output_cts(
+            es, local_share
+        )
+        if not local_share:
+            return
+        # item construction shared with Tpke.dec_share_batch (one
+        # home for the CP context/vk binding)
+        items = view.tpke.dec_share_items(
+            view.keys.tpke_share, issue_cts
+        )
+        for proposer, item in zip(issue_proposers, items):
+            self.hub.stage_dec_issue(
+                self,
+                (epoch, proposer),
+                item,
+                view.keys.tpke_pub.group,
             )
+            self._eager_staged = True
+        if self.trace is not None and issue_proposers:
+            self.trace.instant(
+                "settle",
+                "dec_share_stage",
+                epoch=epoch,
+                ciphertexts=len(issue_proposers),
+            )
+
+    def _drain_dec_issues(self) -> None:
+        """Collect this node's eagerly staged dec shares from the
+        hub's dec-share column (the first taker executes the WHOLE
+        staged pool — see CryptoHub.take_dec_issues) and broadcast
+        them: the piggyback send that rides the current wave's
+        coalescer flush.  One eager_share_waves tick per wave that
+        actually carried eager shares."""
+        if not self._eager_staged:
+            return
+        self._eager_staged = False
+        rows = self.hub.take_dec_issues(self)
+        if not rows:
+            return
+        for (epoch, proposer), share in rows:
+            # one shared payload-construction path with the settler's
+            # issue (per row: stage order spans epochs)
+            self._broadcast_dec_shares(epoch, (proposer,), (share,))
+        self.metrics.eager_share_waves.inc()
 
     # -- the ordered frontier (two-frontier mode) --------------------------
 
@@ -1539,6 +1758,13 @@ class HoneyBadger:
                     )
                 return
             self._record_ordered(epoch, es)
+            if self._pipeline_depth > 1:
+                # K-deep eager path: the epoch's dec shares stage
+                # into the hub's dec-share column during the CURRENT
+                # message wave and piggyback on this turn's coalescer
+                # flush (_drain_dec_issues) instead of waiting for
+                # the next wave's settler pass
+                self._stage_eager_dec_shares(epoch, es)
             if self.trace is not None:
                 self.trace.instant(
                     "epoch",

@@ -76,7 +76,10 @@ import numpy as np
 
 from cleisthenes_tpu.ops.backend import BatchCrypto
 from cleisthenes_tpu.ops.coin import share_batch as coin_share_batch
-from cleisthenes_tpu.ops.tpke import verify_share_groups
+from cleisthenes_tpu.ops.tpke import (
+    issue_shares_batch,
+    verify_share_groups,
+)
 from cleisthenes_tpu.utils.memo import BoundedFifoMemo
 
 # A flush settles in 1-2 wave rounds (branch verdicts unlock decodes
@@ -284,6 +287,20 @@ class CryptoHub:
         # restarted owner object abandons its parked rows (one stale
         # entry per crash — bounded by the run's restart count).
         self._coin_results: Dict[object, List[Tuple]] = {}
+        # Eager dec-share issue column (K-deep pipelined frontiers,
+        # Config.pipeline_depth > 1): the TPKE twin of the coin
+        # column above.  Owners stage (share, base, context, vk)
+        # issue items the moment an epoch ORDERS — mid-wave — and
+        # collect the DhShares at the turn's piggyback drain
+        # (take_dec_issues); the first taker executes the whole
+        # staged pool in one ops.tpke.issue_shares_batch dispatch,
+        # so a wave that orders epochs on several shared-hub nodes
+        # (or K epochs back to back) pays one exponentiation
+        # dispatch and one CP-nonce draw, not one per node per epoch.
+        self.dec_issue_batches = 0
+        self.dec_issue_items = 0
+        self._dec_pool: List[Tuple] = []  # (owner, meta, item, group)
+        self._dec_results: Dict[object, List[Tuple]] = {}
         # per-flush total column width (branch+decode+share items) of
         # every flush that carried work, for the bench's
         # wave_width_p50/p95 counters (bounded; see WAVE_WIDTH_CAP)
@@ -686,8 +703,27 @@ class CryptoHub:
 
     def _run_coin_pool(self) -> None:
         pool, self._coin_pool = self._coin_pool, []
-        # insertion-ordered grouping by group object (DET002: the
-        # dispatch and result order must not depend on hash order)
+
+        def tally(n: int) -> None:
+            self.coin_issue_batches += 1
+            self.coin_issue_items += n
+
+        self._run_owner_pool(
+            pool, coin_share_batch, "coin", "share_batch",
+            self._coin_results, tally,
+        )
+
+    def _run_owner_pool(
+        self, pool, kernel, trace_cat, trace_name, results, tally
+    ) -> None:
+        """The shared discipline of the owner-staged issue columns
+        (coin shares and — K-deep eager mode — TPKE dec shares):
+        insertion-ordered grouping by group object (DET002: dispatch
+        and result order must not depend on hash order), ONE native
+        ``kernel`` dispatch per distinct group over the pool's
+        ``(secret/share, base, context, vk)`` items, results parked
+        per owner in stage order.  ``tally(n_rows)`` bumps the
+        column's batch/item counters."""
         groups: Dict[int, List[Tuple]] = {}
         group_objs: Dict[int, object] = {}
         for row in pool:
@@ -697,9 +733,8 @@ class CryptoHub:
         tr = self.trace
         for gid, rows in groups.items():
             t0 = 0.0 if tr is None else tr.now()
-            self.coin_issue_batches += 1
-            self.coin_issue_items += len(rows)
-            shares = coin_share_batch(
+            tally(len(rows))
+            shares = kernel(
                 [row[2] for row in rows],
                 group=group_objs[gid],
                 backend=self.crypto.engine_backend,
@@ -707,16 +742,53 @@ class CryptoHub:
             )
             if tr is not None:
                 tr.complete(
-                    "coin",
-                    "share_batch",
+                    trace_cat,
+                    trace_name,
                     t0,
                     n=len(rows),
                     owners=len({id(row[0]) for row in rows}),
                 )
             for row, share in zip(rows, shares):
-                self._coin_results.setdefault(row[0], []).append(
+                results.setdefault(row[0], []).append(
                     (row[1], share)
                 )
+
+    # -- dec-share issue column (Config.pipeline_depth > 1) ----------------
+
+    def stage_dec_issue(self, owner, meta, item, group) -> None:
+        """Park one TPKE dec-share issue want (the K-deep eager
+        piggyback path): ``item`` is the ``(share, base, context,
+        vk)`` tuple ``ops.tpke.issue_shares_batch`` takes, ``meta``
+        the owner's own handle (returned with the share), ``group``
+        the issue's GroupParams.  Staging happens the moment an
+        epoch ORDERS — during the message wave — so by the turn's
+        piggyback drain every node's (and every freshly ordered
+        epoch's) wants are pooled."""
+        self._dec_pool.append((owner, meta, item, group))
+
+    def take_dec_issues(self, owner) -> List[Tuple]:
+        """``(meta, DhShare)`` rows for ``owner``, in stage order.
+        If any of the owner's staged items are still pending, the
+        WHOLE pool — every staged owner — executes first in one
+        native dispatch per distinct group (one in practice: the
+        TPKE group is deployment-wide), and each other owner's
+        shares park until its own drain claims them, so broadcast
+        site and order stay per-node deterministic."""
+        if any(row[0] is owner for row in self._dec_pool):
+            self._run_dec_pool()
+        return self._dec_results.pop(owner, [])
+
+    def _run_dec_pool(self) -> None:
+        pool, self._dec_pool = self._dec_pool, []
+
+        def tally(n: int) -> None:
+            self.dec_issue_batches += 1
+            self.dec_issue_items += n
+
+        self._run_owner_pool(
+            pool, issue_shares_batch, "settle", "dec_share_batch",
+            self._dec_results, tally,
+        )
 
     # -- stats -------------------------------------------------------------
 
@@ -729,6 +801,8 @@ class CryptoHub:
             "share_items": self.share_items,
             "coin_issue_batches": self.coin_issue_batches,
             "coin_issue_items": self.coin_issue_items,
+            "dec_issue_batches": self.dec_issue_batches,
+            "dec_issue_items": self.dec_issue_items,
         }
 
 

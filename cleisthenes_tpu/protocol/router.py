@@ -231,8 +231,10 @@ class WaveRouter:
             # settle-only state (two-frontier mode): consensus traffic
             # for it is stale by definition
             return
-        if hb.auto_propose and epoch == hb.epoch and not es.proposed:
-            hb.start_epoch()
+        # the K-deep follow window (== {hb.epoch} at depth 1); the
+        # predicate and RNG-order discipline are the owner's, shared
+        # with the scalar arm so the two can never drift apart
+        hb.maybe_follow_epoch(epoch, es)
         metrics.handler_dispatches.inc()
         if kind == _K_VOTE:
             acs.handle_vote_wave(items)

@@ -470,7 +470,7 @@ class ValidatorHost:
 
         self.watchdog = SloWatchdog(
             metrics=self.node.metrics,
-            pending_fn=self.node.pending_tx_count,
+            pending_fn=self.node.outstanding_tx_count,
             stall_factor=config.slo_stall_factor,
             stall_grace_s=config.slo_stall_grace_s,
             queue_depth_limit=config.slo_queue_depth,

@@ -343,6 +343,27 @@ def render_prometheus(targets: Sequence[ObsTarget]) -> str:
             labels,
             int(router["waves_routed"]),
         )
+        # K-deep pipelined-frontier counters (always present — zeroed
+        # at depth 1 per the schema-stability rule)
+        pipeline = snap["pipeline"]
+        exp.add(
+            exp.family(
+                "pipeline_epochs_in_flight", "gauge",
+                "epochs running RBC/BBA concurrently in the K-deep "
+                "window (1 in steady lockstep)",
+            ),
+            labels,
+            int(pipeline["epochs_in_flight"]),
+        )
+        exp.add(
+            exp.family(
+                "pipeline_eager_share_waves_total", "counter",
+                "delivery waves whose flush carried eagerly "
+                "piggybacked dec shares for a freshly ordered epoch",
+            ),
+            labels,
+            int(pipeline["eager_share_waves"]),
+        )
         for peer, ph in snap.get("transport_health", {}).items():
             plabels = {**labels, "peer": peer}
             exp.add(

@@ -229,6 +229,11 @@ class Metrics:
         self.handler_dispatches = Counter()
         # delivery waves the router demuxed (0 on the scalar arm)
         self.waves_routed = Counter()
+        # K-deep pipelined frontiers (Config.pipeline_depth): waves
+        # whose coalescer flush carried eagerly piggybacked dec
+        # shares for a freshly ordered epoch (0 at depth 1 — the
+        # eager path is gated to the K-deep plane)
+        self.eager_share_waves = Counter()
         self.epoch_latency = Histogram()  # seconds, propose -> commit
         self.acs_latency = Histogram()
         self.decrypt_latency = Histogram()
@@ -276,6 +281,11 @@ class Metrics:
         # roster-version provider (set by the owning HoneyBadger):
         # () -> the ACTIVE roster version (0 = the genesis roster)
         self._roster_version: Optional[Callable[[], int]] = None
+        # pipeline provider (set by the owning HoneyBadger): () ->
+        # the number of epochs currently running RBC/BBA concurrently
+        # (proposed, consensus live, not yet ordered) — the K-deep
+        # window's in-flight gauge, 1 in steady lockstep
+        self._pipeline: Optional[Callable[[], int]] = None
 
     def set_transport_health(
         self, provider: Optional[Callable[[], Dict]]
@@ -308,6 +318,10 @@ class Metrics:
     def set_reconfig(self, provider: Optional[Callable[[], int]]) -> None:
         """Roster-version provider (dynamic membership)."""
         self._roster_version = provider
+
+    def set_pipeline(self, provider: Optional[Callable[[], int]]) -> None:
+        """Epochs-in-flight provider (K-deep pipelined frontiers)."""
+        self._pipeline = provider
 
     def decrypt_lag_epochs(self) -> int:
         """Ordered frontier - settled frontier (0 when no provider is
@@ -440,6 +454,15 @@ class Metrics:
             "handler_dispatches": self.handler_dispatches.value,
             "waves_routed": self.waves_routed.value,
         }
+        # K-deep pipeline block: ALWAYS present with every key,
+        # zeroed at depth 1 / on bare nodes (same schema rule)
+        pipeline: Dict[str, object] = {
+            "epochs_in_flight": 0,
+            "eager_share_waves": self.eager_share_waves.value,
+        }
+        if self._pipeline is not None:
+            pipeline["epochs_in_flight"] = int(self._pipeline())
+        out["pipeline"] = pipeline
         # every transport key is ALWAYS present (zeroed when no frame
         # counters registered): scrapers and the timeseries sampler
         # must never see a key appear/disappear between snapshots —

@@ -180,6 +180,21 @@ def test_byzantine_invalid_dec_share_falls_back_to_verified_path():
         ]
 
     hb_bad.tpke.dec_share_batch = junk_dec_share_batch
+    # the K-deep eager path (Config.pipeline_depth > 1) issues
+    # through the hub's dec-share column instead of tpke — tamper
+    # that seam identically so the junk rides either issue path
+    real_take = hb_bad.hub.take_dec_issues
+
+    def junk_take(owner):
+        rows = real_take(owner)
+        if owner is hb_bad:
+            rows = [
+                (meta, DhShare(index=s.index, d=12345, e=s.e, z=s.z))
+                for meta, s in rows
+            ]
+        return rows
+
+    hb_bad.hub.take_dec_issues = junk_take
     push_txs(nodes, 12)
     run_epochs(net, nodes)
     assert_identical_batches(nodes)

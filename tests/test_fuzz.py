@@ -187,6 +187,22 @@ def test_fuzz_deep_sweep():
 
 
 @pytest.mark.slow
+def test_fuzz_pipeline_deep_sweep():
+    """The K-deep pipelined-frontier deep band (ISSUE 15): 200
+    sampled composite schedules pinned alternately to depth 2 and
+    depth 4 — the cross-frontier invariants (settled prefix ⊆
+    ordered log, byte-identical honest ordered logs, decrypt-lag
+    bound) must hold over the widened in-flight window (ci.sh runs
+    the 20-seed smoke band of this sampler)."""
+    for seed in range(20, 220):
+        depth = 2 if seed % 2 else 4
+        v = run_schedule(
+            sample_schedule(seed, pipeline_depth=depth)
+        )
+        assert v is None, f"seed {seed} depth {depth}: {v}"
+
+
+@pytest.mark.slow
 def test_fuzz_reconfig_deep_sweep():
     """The dynamic-membership deep band: 200 reconfig-bearing
     schedules — every sampled crash/partition/semantic composite runs

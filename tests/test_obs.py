@@ -120,6 +120,12 @@ def test_transport_block_uniform_on_bare_metrics():
         "coin_share_batches": 0,
         "coin_share_items": 0,
     }
+    # K-deep pipeline block (ISSUE 15): same zeroed-key schema rule
+    # — present on bare metrics, at depth 1, and on every transport
+    assert snap["pipeline"] == {
+        "epochs_in_flight": 0,
+        "eager_share_waves": 0,
+    }
 
 
 def test_flatten_snapshot_numeric_leaves_only():
@@ -222,6 +228,10 @@ def _golden_target() -> ObsTarget:
     # path; pinned nonzero so the golden scrape covers the families
     m.handler_dispatches.inc(12)
     m.waves_routed.inc(4)
+    # K-deep pipeline counters (ISSUE 15): pinned nonzero so the
+    # golden scrape covers the new families
+    m.eager_share_waves.inc(2)
+    m.set_pipeline(lambda: 3)
     m.tx_per_sec = lambda: 1.5  # pin the one wall-clock-derived gauge
     m.set_transport_stats(
         lambda: {
@@ -381,7 +391,10 @@ def test_epoch_stall_watchdog_fires_under_selective_mute():
     cluster.run_until_drained(max_rounds=2)
     honest = cluster.nodes["node000"]
     assert honest.metrics.epochs_committed.value == 0  # truly stalled
-    assert honest.pending_tx_count() > 0
+    # the K-deep pipeline window may have absorbed the whole queue
+    # into in-flight proposals; the watchdog reads the OUTSTANDING
+    # count (queue + in-flight) so a stalled node still shows work
+    assert honest.outstanding_tx_count() > 0
     wd = cluster.watchdogs["node000"]
     # synthetic clock: drive past the budget without sleeping
     assert wd.check(now=honest.metrics._t0 + 1000.0) == "down"

@@ -130,7 +130,14 @@ def test_pair_mac_key_symmetry():
 def test_config_validates_reconfig_lead():
     with pytest.raises(ValueError):
         Config(n=4, decrypt_lag_max=4, reconfig_lead=4)
-    Config(n=4, decrypt_lag_max=4, reconfig_lead=5)  # ok
+    # ISSUE 15: the bound now clears the K-deep in-flight window too
+    # (reconfig_lead > pipeline_depth + decrypt_lag_max)
+    with pytest.raises(ValueError):
+        Config(
+            n=4, decrypt_lag_max=4, pipeline_depth=2, reconfig_lead=6
+        )
+    Config(n=4, decrypt_lag_max=4, pipeline_depth=1, reconfig_lead=6)  # ok
+    Config(n=4, decrypt_lag_max=4, pipeline_depth=2, reconfig_lead=7)  # ok
 
 
 # ---------------------------------------------------------------------------
@@ -474,6 +481,10 @@ def test_grpc_join_and_wal_replay_across_reconfig(tmp_path):
         dial_retry_max_s=1.0,
         decrypt_lag_max=2,
         reconfig_lead=4,
+        # lockstep window keeps this scenario's tight reconfig_lead
+        # legal (ISSUE 15 validates lead > depth + lag); the K-deep
+        # reconfig-boundary case lives in tests/test_pipeline_depth.py
+        pipeline_depth=1,
     )
     ids = [f"node{i}" for i in range(n)]
     keys = setup_keys(cfg, ids, seed=77)

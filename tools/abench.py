@@ -47,7 +47,7 @@ WORKTREE_DIR = REPO_ROOT / ".abench"
 # exist on every ref this harness will realistically compare, and
 # degrades gracefully (nulls) where a ref lacks the newer metrics.
 _PROBE = r"""
-import json, statistics, sys, time
+import json, os, statistics, sys, time
 import numpy as np
 from cleisthenes_tpu.config import Config
 from cleisthenes_tpu.protocol.cluster import SimulatedCluster
@@ -55,11 +55,19 @@ from cleisthenes_tpu.protocol.cluster import SimulatedCluster
 n, batch, epochs, seed = (
     int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4])
 )
+# per-arm Config overrides (ABENCH_CONFIG_OVERRIDES, a JSON object of
+# Config kwargs): the ISSUE-15 depth A/B pits pipeline_depth=K
+# against depth 1 on the SAME code — only pass overrides to arms
+# whose tree knows the fields
+overrides = json.loads(os.environ.get("ABENCH_CONFIG_OVERRIDES", "{}"))
 # the production shape: work pre-submitted, auto-propose on, ONE
 # net.run chains every epoch back to back — the shape where cross-
 # epoch pipelining (old or two-frontier) is actually reachable.
 cluster = SimulatedCluster(
-    config=Config(n=n, batch_size=batch, crypto_backend="cpu", seed=seed),
+    config=Config(
+        n=n, batch_size=batch, crypto_backend="cpu", seed=seed,
+        **overrides
+    ),
     key_seed=77,
     auto_propose=True,
 )
@@ -151,11 +159,20 @@ def remove_worktree(tree: pathlib.Path) -> None:
 
 
 def run_sample(
-    tree: pathlib.Path, n: int, batch: int, epochs: int, seed: int
+    tree: pathlib.Path,
+    n: int,
+    batch: int,
+    epochs: int,
+    seed: int,
+    overrides: Optional[Dict] = None,
 ) -> Dict:
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("PYTHONPATH", None)  # each arm imports from its own tree
+    if overrides:
+        env["ABENCH_CONFIG_OVERRIDES"] = json.dumps(overrides)
+    else:
+        env.pop("ABENCH_CONFIG_OVERRIDES", None)
     proc = subprocess.run(
         [sys.executable, "-c", _PROBE,
          str(n), str(batch), str(epochs), str(seed)],
@@ -192,24 +209,41 @@ def run_ab(
     seed: int = 99,
     keep_worktree: bool = False,
     progress=print,
+    head_overrides: Optional[Dict] = None,
+    base_overrides: Optional[Dict] = None,
 ) -> Dict:
     """The paired A/B: HEAD and BASE_REF sampled alternately, one
-    warm-up pair discarded, ratios computed per pair."""
-    base_tree = materialize_ref(base_ref)
+    warm-up pair discarded, ratios computed per pair.
+
+    ``base_ref="self"`` runs BOTH arms from the working tree — the
+    same-code configuration A/B (the ISSUE-15 depth comparison:
+    ``--head-overrides '{"pipeline_depth":4,...}'`` vs
+    ``--base-overrides '{"pipeline_depth":1}'``); per-arm Config
+    kwargs ride ABENCH_CONFIG_OVERRIDES into the probe."""
+    self_ab = base_ref == "self"
+    base_tree = REPO_ROOT if self_ab else materialize_ref(base_ref)
     head: List[Dict] = []
     base: List[Dict] = []
     try:
         # warm-up pair (imports, JIT, page cache) — never reported
         progress(f"[abench] warm-up pair (base={base_ref})")
-        run_sample(REPO_ROOT, n, batch, epochs, seed)
-        run_sample(base_tree, n, batch, epochs, seed)
+        run_sample(REPO_ROOT, n, batch, epochs, seed,
+                   overrides=head_overrides)
+        run_sample(base_tree, n, batch, epochs, seed,
+                   overrides=base_overrides)
         for i in range(pairs):
             progress(f"[abench] pair {i + 1}/{pairs} head")
-            head.append(run_sample(REPO_ROOT, n, batch, epochs, seed))
+            head.append(
+                run_sample(REPO_ROOT, n, batch, epochs, seed,
+                           overrides=head_overrides)
+            )
             progress(f"[abench] pair {i + 1}/{pairs} base")
-            base.append(run_sample(base_tree, n, batch, epochs, seed))
+            base.append(
+                run_sample(base_tree, n, batch, epochs, seed,
+                           overrides=base_overrides)
+            )
     finally:
-        if not keep_worktree:
+        if not self_ab and not keep_worktree:
             remove_worktree(base_tree)
     wall_ratios = [
         _ratio(h.get("epoch_wall_ms"), b.get("epoch_wall_ms"))
@@ -253,6 +287,8 @@ def run_ab(
         "metric": "abench_paired",
         "base_ref": base_ref,
         "head_dirty": head_dirty,
+        "head_overrides": head_overrides or {},
+        "base_overrides": base_overrides or {},
         "n": n,
         "batch": batch,
         "epochs": epochs,
@@ -276,7 +312,20 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="tools.abench", description=__doc__.splitlines()[0]
     )
-    ap.add_argument("base_ref", help="git ref for the base arm")
+    ap.add_argument(
+        "base_ref",
+        help="git ref for the base arm, or 'self' to run both arms "
+        "from the working tree (configuration A/B via overrides)",
+    )
+    ap.add_argument(
+        "--head-overrides", default=None, metavar="JSON",
+        help="Config kwargs (JSON object) for the head arm, e.g. "
+        '\'{"pipeline_depth": 4, "reconfig_lead": 12}\'',
+    )
+    ap.add_argument(
+        "--base-overrides", default=None, metavar="JSON",
+        help="Config kwargs (JSON object) for the base arm",
+    )
     ap.add_argument("--n", type=int, default=16)
     ap.add_argument("--batch", type=int, default=256)
     ap.add_argument("--epochs", type=int, default=3)
@@ -304,6 +353,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         seed=args.seed,
         keep_worktree=args.keep_worktree,
         progress=lambda msg: print(msg, file=sys.stderr, flush=True),
+        head_overrides=(
+            json.loads(args.head_overrides)
+            if args.head_overrides
+            else None
+        ),
+        base_overrides=(
+            json.loads(args.base_overrides)
+            if args.base_overrides
+            else None
+        ),
     )
     if not args.no_trend:
         # paired A/B reports join the durable trend: the same-box
@@ -321,6 +380,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             "batch": args.batch,
             "epochs": args.epochs,
             "seed": args.seed,
+            # configuration A/B (base_ref 'self'): the overrides ARE
+            # the identity of the comparison
+            "head_overrides": report["head_overrides"],
+            "base_overrides": report["base_overrides"],
         }
         try:
             append_record(args.trend, record)

@@ -96,7 +96,11 @@ class Violation(Exception):
 
 
 def sample_schedule(
-    seed: int, n: int = 4, rounds: int = 12, reconfig: bool = False
+    seed: int,
+    n: int = 4,
+    rounds: int = 12,
+    reconfig: bool = False,
+    pipeline_depth: Optional[int] = None,
 ) -> dict:
     """One composite fault schedule, a pure function of ``seed``.
 
@@ -110,7 +114,12 @@ def sample_schedule(
     with the retirement of a COALITION member — so crash/partition/
     semantic schedules run ACROSS a reshare ceremony and an
     activation boundary, and the safety invariants span the roster
-    change."""
+    change.
+
+    ``pipeline_depth`` pins the K-deep protocol-plane window (the
+    ci.sh depth band); None draws it from the seed (LAST, so the
+    depth key extends the historical schedule stream instead of
+    reshuffling it), spanning lockstep and pipelined windows."""
     rng = random.Random(seed)
     f = (n - 1) // 3
     ids = [f"node{i:03d}" for i in range(n)]
@@ -173,10 +182,16 @@ def sample_schedule(
         }
         timeline.append(ev)
     timeline.sort(key=lambda ev: (ev["round"], ev["op"], ev["node"]))
+    if pipeline_depth is None:
+        # K-deep pipelined frontiers (ISSUE 15): the cross-frontier
+        # invariants must hold over every window width, so depth is
+        # part of the sampled schedule space
+        pipeline_depth = rng.choice((1, 2, 4))
 
     return {
         "version": SCHEDULE_VERSION,
         "seed": seed,
+        "pipeline_depth": pipeline_depth,
         "n": n,
         "f": f,
         "batch_size": 8,
@@ -207,6 +222,10 @@ def _build_cluster(schedule: dict, trace: bool) -> SimulatedCluster:
         nid: (bs[0] if len(bs) == 1 else CompositeBehavior(bs))
         for nid, bs in by_node.items()
     }
+    depth = int(schedule.get("pipeline_depth", 1))
+    # the lead must clear depth + the DEFAULT lag the cluster runs
+    # under (read off the dataclass, never a re-stated literal)
+    lag = Config.__dataclass_fields__["decrypt_lag_max"].default
     cfg = Config(
         n=schedule["n"],
         batch_size=schedule["batch_size"],
@@ -218,6 +237,11 @@ def _build_cluster(schedule: dict, trace: bool) -> SimulatedCluster:
         # their own — a band stays pinned to it (the key round-trips
         # through repro files like every other schedule field)
         wave_routing=schedule.get("wave_routing", True),
+        # K-deep window (ISSUE 15): depth rides the schedule; the
+        # reconfig lead stretches with it where the default would
+        # violate Config's lead > depth + decrypt_lag_max bound
+        pipeline_depth=depth,
+        reconfig_lead=max(8, depth + lag + 1),
     )
     cluster = SimulatedCluster(
         n=schedule["n"],
@@ -546,6 +570,7 @@ def fuzz_seeds(
     out_dir: Optional[str] = None,
     trace: bool = True,
     reconfig: bool = False,
+    pipeline_depth: Optional[int] = None,
 ) -> int:
     """Run a schedule per seed; on the first violation, shrink it and
     emit a repro file plus (by default) a flight-recorder trace
@@ -555,7 +580,11 @@ def fuzz_seeds(
 
     for seed in seeds:
         schedule = sample_schedule(
-            seed, n=n, rounds=rounds, reconfig=reconfig
+            seed,
+            n=n,
+            rounds=rounds,
+            reconfig=reconfig,
+            pipeline_depth=pipeline_depth,
         )
         violation = run_schedule(schedule)
         if violation is None:
@@ -589,6 +618,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         action="store_true",
         help="dynamic-membership band: compose a join/retire "
         "reconfig event into every sampled schedule",
+    )
+    ap.add_argument(
+        "--pipeline-depth",
+        type=int,
+        default=None,
+        help="pin the K-deep protocol-plane window "
+        "(Config.pipeline_depth) in every sampled schedule; "
+        "default draws depth from the seed",
     )
     ap.add_argument(
         "--show", action="store_true", help="print the schedule, no run"
@@ -628,6 +665,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             schedule = sample_schedule(
                 seed, n=args.n, rounds=args.rounds,
                 reconfig=args.reconfig,
+                pipeline_depth=args.pipeline_depth,
             )
             json.dump(schedule, sys.stdout, indent=2, sort_keys=True)
             print()
@@ -639,6 +677,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         out_dir=args.out,
         trace=not args.no_trace,
         reconfig=args.reconfig,
+        pipeline_depth=args.pipeline_depth,
     )
 
 

@@ -290,6 +290,11 @@ def run_sample(
             # (staticcheck ARM001 cross-checks the set)
             "hub_wave_flush": bool(cfg.hub_wave_flush),
             "epoch_pipelining": bool(cfg.epoch_pipelining),
+            # K-deep pipelined frontiers (ISSUE 15): the depth
+            # changes how many epochs share each wave — and with
+            # them what every per-epoch dispatch counter MEANS — so
+            # runs gate only against same-depth trend records
+            "pipeline_depth": int(cfg.pipeline_depth),
         },
         "epoch_p50_ms": round(p50 * 1000.0, 3),
         "epoch_p95_ms": round(p95 * 1000.0, 3),
