@@ -792,6 +792,76 @@ def order_overlap_section(backend: str) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# WAN emulation scenarios (ISSUE 16): geo-realistic schedules
+# ---------------------------------------------------------------------------
+
+
+def measure_wan(backend: str, profile: str, n: int = 4,
+                batch: int = 32, epochs: int = 3) -> dict:
+    """One seeded WAN profile end to end: n validators over the
+    channel transport with the link-model plane mounted, ``epochs``
+    committed epochs back to back.  The headline is virtual time per
+    settled epoch (the geo-latency cost the link model charges the
+    schedule) next to host wall — plus the model's own evidence
+    (retransmits, straggler episodes, frames delayed)."""
+    from cleisthenes_tpu.config import Config
+    from cleisthenes_tpu.protocol.cluster import SimulatedCluster
+
+    cfg = Config(n=n, batch_size=batch, crypto_backend=backend, seed=5)
+    cluster = SimulatedCluster(
+        config=cfg,
+        key_seed=55,
+        auto_propose=True,
+        shared_hub=True,
+        wan_profile=profile,
+    )
+    rng = np.random.default_rng(21)
+    t0 = time.perf_counter()
+    for _ in range(epochs):
+        for _ in range(batch):
+            cluster.submit(
+                rng.integers(
+                    0, 256, size=TX_BYTES, dtype=np.uint8
+                ).tobytes()
+            )
+        cluster.run_until_drained(max_rounds=80)
+    wall_ms = (time.perf_counter() - t0) * 1000.0
+    cluster.assert_agreement()
+    n0 = cluster.nodes[cluster.ids[0]]
+    settled = n0.settled_epoch + 1
+    assert settled >= epochs, (
+        f"wan profile {profile}: settled {settled} < {epochs}"
+    )
+    stats = cluster.net.wan.stats()
+    health = cluster.health()
+    return {
+        "profile": profile,
+        "settled_epochs": settled,
+        "virtual_ms_per_epoch": round(
+            int(stats["virtual_time_ms"]) / settled, 1
+        ),
+        "wall_ms_per_epoch": round(wall_ms / settled, 1),
+        "frames_delayed": stats["frames_delayed"],
+        "retransmits": stats["retransmits"],
+        "straggler_episodes": stats["straggler_episodes"],
+        "health": health["status"],
+    }
+
+
+def wan_section(backend: str) -> dict:
+    """The named profile matrix under the SAME seeded workload: how
+    much schedule time each geography charges, and that every profile
+    still commits with agreement (the degradation-hardening evidence
+    next to the perf numbers)."""
+    from cleisthenes_tpu.transport.wan import wan_profile_names
+
+    return {
+        profile: measure_wan(backend, profile)
+        for profile in wan_profile_names()
+    }
+
+
+# ---------------------------------------------------------------------------
 # harness: subprocess isolation + relay probing + guaranteed JSON output
 # ---------------------------------------------------------------------------
 
@@ -964,6 +1034,12 @@ def run_child() -> None:
     if on_tpu:
         progress("order_overlap tpu")
         out["order_overlap"]["tpu"] = order_overlap_section("tpu")
+    # WAN emulation scenarios (ISSUE 16): virtual geo-latency charged
+    # per settled epoch across the named profile matrix.  A protocol-
+    # structure artifact like order_overlap — cpu arm only (the link
+    # model runs in the scheduler, not on the chip).
+    progress("wan_scenarios")
+    out["wan_scenarios"] = wan_section(cpu_ref)
     progress("modexp_wide")
     if on_tpu:
         # first time these wide-limb programs meet a real chip: a

@@ -120,6 +120,14 @@ JAX_PLATFORMS=cpu python -m tools.fuzz --seeds 0:10 \
     --pipeline-depth 2 --out "$FUZZ_OUT"
 JAX_PLATFORMS=cpu python -m tools.fuzz --seeds 10:20 \
     --pipeline-depth 4 --out "$FUZZ_OUT"
+# WAN emulation band (ISSUE 16): the same composite schedules over a
+# seeded link-model plane — per-link latency/jitter/loss/bandwidth,
+# heavy-tailed stragglers — with the profile itself drawn from the
+# seed; every invariant must hold under geo-realistic delivery
+# schedules (the 200-seed deep sweep rides the slow tier,
+# tests/test_fuzz.py::test_fuzz_wan_deep_sweep)
+JAX_PLATFORMS=cpu python -m tools.fuzz --seeds 0:20 --wan \
+    --out "$FUZZ_OUT"
 rm -rf "$FUZZ_OUT"
 
 if [[ "${CI_FAST:-0}" == "1" ]]; then

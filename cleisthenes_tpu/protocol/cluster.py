@@ -98,6 +98,7 @@ class SimulatedCluster:
         member_ids: Optional[Sequence[str]] = None,
         behaviors: Optional[Dict[str, object]] = None,
         wal_dir: Optional[str] = None,
+        wan_profile: Optional[object] = None,
     ) -> None:
         if config is not None:
             if n != 4 and n != config.n:  # both given and conflicting
@@ -115,11 +116,16 @@ class SimulatedCluster:
         self._key_seed = key_seed
         self.keys = setup_keys(self.config, self.ids, seed=key_seed,
                                group=group)
+        # wan_profile (ISSUE 16): a name from transport.wan.PROFILES
+        # (or a WanProfile) mounts the seeded link-delay model on the
+        # channel scheduler — geo-realistic delivery schedules priced
+        # on a virtual clock, still byte-identical for a fixed seed
         self.net = ChannelNetwork(
             seed=seed,
             delivery_columnar=self.config.delivery_columnar,
             wave_routing=self.config.wave_routing,
             egress_columnar=self.config.egress_columnar,
+            wan_profile=wan_profile,
         )
         # dedup=True: the shared hub verifies each distinct pure crypto
         # check ONCE for the whole roster (see CryptoHub docstring) —
@@ -195,6 +201,8 @@ class SimulatedCluster:
             hb.metrics.set_transport_stats(
                 lambda nid=nid: self.net.endpoint_stats(nid)
             )
+            if self.net.wan is not None:
+                hb.metrics.set_wan_stats(self.net.wan.stats)
         self._rr = 0  # submit() round-robin cursor
         # SLO watchdog plane (utils/watchdog.py): one per node, peer
         # state from the channel network's fault view (crash/partition)
@@ -216,6 +224,7 @@ class SimulatedCluster:
                 peer_states_fn=lambda nid=nid: self.net.link_states(nid),
                 peer_lag_fn=lambda nid=nid: self._peer_lag(nid),
                 decrypt_lag_budget=self.config.decrypt_lag_max,
+                budget_floor_fn=self._wan_floor,
                 trace=self.nodes[nid].trace,
             )
             self.nodes[nid].metrics.set_alerts(wd.alerts_block)
@@ -351,6 +360,8 @@ class SimulatedCluster:
         hb.metrics.set_transport_stats(
             lambda nid=nid: self.net.endpoint_stats(nid)
         )
+        if self.net.wan is not None:
+            hb.metrics.set_wan_stats(self.net.wan.stats)
         # rewire the observability plane to the NEW instance: the old
         # watchdog/sampler closures hold the dead node's metrics and
         # would keep feeding frozen pre-crash state to SLO checks and
@@ -367,6 +378,7 @@ class SimulatedCluster:
             peer_states_fn=lambda nid=nid: self.net.link_states(nid),
             peer_lag_fn=lambda nid=nid: self._peer_lag(nid),
             decrypt_lag_budget=self.config.decrypt_lag_max,
+            budget_floor_fn=self._wan_floor,
             trace=hb.trace,
         )
         hb.metrics.set_alerts(wd.alerts_block)
@@ -529,6 +541,8 @@ class SimulatedCluster:
         hb.metrics.set_transport_stats(
             lambda jid=jid: self.net.endpoint_stats(jid)
         )
+        if self.net.wan is not None:
+            hb.metrics.set_wan_stats(self.net.wan.stats)
         if jid not in self.ids:
             self.ids.append(jid)
             self.ids.sort()
@@ -542,6 +556,7 @@ class SimulatedCluster:
             peer_states_fn=lambda jid=jid: self.net.link_states(jid),
             peer_lag_fn=lambda jid=jid: self._peer_lag(jid),
             decrypt_lag_budget=self.config.decrypt_lag_max,
+            budget_floor_fn=self._wan_floor,
             trace=hb.trace,
         )
         hb.metrics.set_alerts(wd.alerts_block)
@@ -562,6 +577,14 @@ class SimulatedCluster:
         return secret, pub
 
     # -- observability (telemetry + SLO surface) ---------------------------
+
+    def _wan_floor(self) -> float:
+        """The epoch-stall budget floor the mounted WAN profile needs
+        (0 without one) — keeps a p50 self-calibrated on fast local
+        epochs from flipping DOWN when the link model's delay tail
+        lands (ISSUE 16 watchdog hardening)."""
+        wan = self.net.wan
+        return 0.0 if wan is None else wan.stall_floor_s()
 
     def _peer_lag(self, node_id: str) -> Dict[str, int]:
         """``node_id``'s view of peers trailing its epoch frontier

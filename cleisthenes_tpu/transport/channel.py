@@ -21,9 +21,10 @@ adversaries.
 from __future__ import annotations
 
 import collections
+import heapq
 import random
 import time
-from typing import Callable, Dict, List, Optional, Set, Tuple
+from typing import Callable, Dict, List, Optional, Set, Tuple, Union
 
 from cleisthenes_tpu.transport.base import (
     Authenticator,
@@ -40,6 +41,7 @@ from cleisthenes_tpu.transport.message import (
     encode_message,
     payload_body_count,
 )
+from cleisthenes_tpu.transport.wan import WanEmulator, WanProfile
 
 # A fault filter sees (sender_id, receiver_id, wire_bytes) and returns
 # what to deliver: bytes (pass/tamper), None (drop), or a list of
@@ -146,6 +148,7 @@ class ChannelNetwork:
         delivery_columnar: bool = False,
         wave_routing: bool = False,
         egress_columnar: bool = False,
+        wan_profile: Optional[Union[str, WanProfile]] = None,
     ):
         # seed=None -> FIFO delivery; seed=int -> seeded random-order
         # delivery (the adversarial asynchronous scheduler from
@@ -205,6 +208,24 @@ class ChannelNetwork:
         # byte-equivalence proof compares across arms.  None in all
         # non-test use.
         self.frame_tap: Optional[Callable[[str, str, bytes], None]] = None
+        # Seeded WAN emulation plane (ISSUE 16): when a profile is
+        # mounted, every _enqueue prices the frame through a per-link
+        # LinkModel (base RTT, jitter, retransmission delay, bandwidth
+        # serialization, straggler episodes) into a VIRTUAL-clock
+        # delivery deadline.  Undelivered frames wait in _wan_holding
+        # — a (ready_at, seq, entry) min-heap invisible to
+        # _prepare_wave/_step_wave — until _wan_release moves them to
+        # _pending; when the visible queue drains the clock jumps to
+        # the next deadline (quantum-coalesced).  The seq tiebreak
+        # keeps heap order a pure function of admission order, so a
+        # fixed (seed, profile) replays byte-identically.
+        self.wan = (
+            WanEmulator(wan_profile, seed)
+            if wan_profile is not None
+            else None
+        )
+        self._wan_holding: list = []
+        self._wan_seq = 0
 
     # -- topology ----------------------------------------------------------
 
@@ -222,6 +243,8 @@ class ChannelNetwork:
                 FrameEncodeMemo() if self._egress_columnar else None
             ),
         )
+        if self.wan is not None:
+            self.wan.register(node_id)
 
     def rebind_handler(self, node_id: str, handler: Handler) -> None:
         self._endpoints[node_id].bind(handler)
@@ -278,24 +301,43 @@ class ChannelNetwork:
             "encode_memo_misses": emisses,
         }
 
-    def link_states(self, node_id: str) -> Dict[str, str]:
-        """``node_id``'s view of every peer link: "down" when the peer
-        crashed or a partition severs the pair, else "up" — the
+    def link_states(self, node_id: str) -> Dict[str, Dict[str, object]]:
+        """``node_id``'s view of every peer link — the
         channel-transport analog of the gRPC dial layer's
         PeerHealthTracker, feeding the SLO watchdog's peer detector
         (the public route to fault state; /healthz must degrade under
-        an injected partition on THIS transport too)."""
-        return {
-            peer: (
-                "down"
-                if peer in self._crashed
+        an injected partition on THIS transport too).
+
+        Per peer: ``state`` ("down" when the peer crashed or a
+        partition severs the pair; "straggling" when a mounted WAN
+        profile has either endpoint inside a slow episode — alive but
+        DEGRADED-grade, never DOWN; else "up"), plus the link model's
+        ``rtt_ms`` / ``loss`` / ``straggling`` fields (zeroed without
+        a WAN profile)."""
+        wan = self.wan
+        out: Dict[str, Dict[str, object]] = {}
+        for peer in sorted(self._endpoints):
+            if peer == node_id:
+                continue
+            down = (
+                peer in self._crashed
                 or node_id in self._crashed
                 or (node_id, peer) in self._partitions
-                else "up"
             )
-            for peer in sorted(self._endpoints)
-            if peer != node_id
-        }
+            if wan is None:
+                info: Dict[str, object] = {
+                    "rtt_ms": 0.0,
+                    "loss": 0.0,
+                    "straggling": False,
+                }
+            else:
+                info = wan.link_info(node_id, peer)
+            state = "down" if down else (
+                "straggling" if info["straggling"] else "up"
+            )
+            info["state"] = state
+            out[peer] = info
+        return out
 
     # -- fault injection ---------------------------------------------------
 
@@ -315,6 +357,14 @@ class ChannelNetwork:
         else:
             self._pending = kept
         self._unprepared = sum(1 for it in kept if it[4] is None)
+        if self._wan_holding:
+            # WAN-held frames die with the host's buffers too
+            self._wan_holding = [
+                (t, s, it)
+                for (t, s, it) in self._wan_holding
+                if it[0] != node_id and it[1] != node_id
+            ]
+            heapq.heapify(self._wan_holding)
 
     def recover(self, node_id: str) -> None:
         """Un-crash, keeping the node's old handler (a blip, not a
@@ -357,8 +407,40 @@ class ChannelNetwork:
         self.bytes_posted += len(wire)
         if self.frame_tap is not None:
             self.frame_tap(sender_id, receiver_id, wire)
-        self._pending.append([sender_id, receiver_id, wire, False, None])
+        entry = [sender_id, receiver_id, wire, False, None]
+        if self.wan is not None:
+            # WAN admission: the frame is priced into a virtual-clock
+            # deadline and held invisible to the scheduler (and to the
+            # wave passes) until _wan_release moves it over
+            ready_at = self.wan.admit(sender_id, receiver_id, len(wire))
+            heapq.heappush(
+                self._wan_holding, (ready_at, self._wan_seq, entry)
+            )
+            self._wan_seq += 1
+            return
+        self._pending.append(entry)
         self._unprepared += 1
+
+    def _wan_release(self) -> None:
+        """Move every WAN-held frame whose deadline the virtual clock
+        has passed into the visible pending queue.  When the visible
+        queue is empty, the clock first jumps to the earliest held
+        deadline plus one delivery quantum — co-deadline frames (an
+        RBC echo wave, a broadcast fan-out) land in the same wave
+        instead of one wave per float, keeping step counts bounded
+        without changing which frames *can* be seen before others."""
+        wan, holding = self.wan, self._wan_holding
+        if wan is None or not holding:
+            return
+        if not self._pending and holding[0][0] > wan.now:
+            wan.advance(
+                holding[0][0] + wan.profile.delivery_quantum_ms / 1e3
+            )
+        now = wan.now
+        while holding and holding[0][0] <= now:
+            _, _, entry = heapq.heappop(holding)
+            self._pending.append(entry)
+            self._unprepared += 1
 
     def post(self, sender_id: str, receiver_id: str, msg: Message) -> None:
         """Sign, encode and enqueue one message."""
@@ -372,7 +454,7 @@ class ChannelNetwork:
             # encoded body instead of re-encoding the envelope
             self.post_wave(sender_id, (((receiver_id,), msg),))
             return
-        if len(self._pending) >= self._queue_capacity:
+        if self.pending_count() >= self._queue_capacity:
             raise OverflowError("channel network queue full")
         if ep is None:
             wire = encode_message(msg)  # staticcheck: allow[DET006] non-endpoint test rig
@@ -413,7 +495,7 @@ class ChannelNetwork:
             msg, receiver_ids
         )
         for rid, wire in frames.items():
-            if len(self._pending) >= self._queue_capacity:
+            if self.pending_count() >= self._queue_capacity:
                 raise OverflowError("channel network queue full")
             self._enqueue(sender_id, rid, wire)
 
@@ -438,7 +520,7 @@ class ChannelNetwork:
                     self.post(sender_id, rid, msg)
             return
         need = sum(len(rids) for rids, _msg in entries)
-        if len(self._pending) + need > self._queue_capacity:
+        if self.pending_count() + need > self._queue_capacity:
             raise OverflowError("channel network queue full")
         tr = getattr(ep.handler, "trace", None)
         t0 = 0.0 if tr is None else tr.now()
@@ -470,7 +552,8 @@ class ChannelNetwork:
                 self._enqueue(sender_id, rid, frames[rid])
 
     def pending_count(self) -> int:
-        return len(self._pending)
+        """In-flight frames: scheduler-visible plus WAN-held."""
+        return len(self._pending) + len(self._wan_holding)
 
     def _prepare_wave(self) -> None:
         """Columnar arm: decode (shared-prefix memoized) and
@@ -672,6 +755,8 @@ class ChannelNetwork:
         messages appear) — exactly what ``run()`` does — or buffered
         work strands and the protocol stalls without error.
         """
+        if self.wan is not None:
+            self._wan_release()
         if self._wave_routing:
             return self._step_wave()
         columnar = self._columnar and self.fault_filter is None
@@ -795,6 +880,12 @@ class ChannelNetwork:
                 continue
             self.idle_phase()
             if not self._pending:
+                if self._wan_holding:
+                    # quiescent wall-side but WAN-held frames remain:
+                    # the next step() advances the virtual clock to
+                    # their deadline instead of declaring the network
+                    # drained
+                    continue
                 break
         return steps
 

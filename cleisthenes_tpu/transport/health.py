@@ -46,14 +46,25 @@ _DELAY_KEEP = 16
 
 
 class Backoff:
-    """Capped exponential backoff with jitter.
+    """Capped exponential backoff with jitter and a stability-gated
+    reset.
 
     ``next_delay()`` returns base * factor^k jittered +/-25% so
     independent retriers spread out, then capped at ``max_s`` —
     ``max_s`` is a HARD bound (operators tune it to bound reconnect
-    latency), so the jitter never overshoots it.  ``reset()`` re-arms
-    after a success.  Deterministic for a seeded ``rng`` (fault
-    tests), OS-random otherwise.
+    latency), so the jitter never overshoots it.  Deterministic for a
+    seeded ``rng`` (fault tests), OS-random otherwise.
+
+    Re-arming: a bare ``reset()`` re-arms unconditionally, but the
+    dial layer must NOT call it on every successful dial — a WAN link
+    that flaps (dial lands, stream dies seconds later, repeat) would
+    then be re-probed from ``base_s`` forever, hammering the remote at
+    base cadence with the cap never reached (the ISSUE 16 regression).
+    Instead the owner reports ``note_connected()`` / ``note_lost()``
+    and the schedule re-arms only when the connection stayed up for at
+    least ``stability_s`` (default: ``max_s`` — a link must survive
+    one full max-backoff period to count as healed); a shorter-lived
+    success CONTINUES the capped seeded-jitter schedule.
     """
 
     def __init__(
@@ -62,6 +73,7 @@ class Backoff:
         max_s: float,
         rng: Optional[random.Random] = None,
         factor: float = 2.0,
+        stability_s: Optional[float] = None,
     ) -> None:
         if base_s <= 0 or max_s < base_s:
             raise ValueError(f"backoff needs 0 < base <= max, "
@@ -69,8 +81,10 @@ class Backoff:
         self.base_s = base_s
         self.max_s = max_s
         self.factor = factor
+        self.stability_s = max_s if stability_s is None else stability_s
         self._rng = rng if rng is not None else random.Random()
         self._cur = base_s
+        self._connected_at: Optional[float] = None
 
     def next_delay(self) -> float:
         d = self._cur
@@ -79,6 +93,26 @@ class Backoff:
 
     def reset(self) -> None:
         self._cur = self.base_s
+
+    def note_connected(self, now: Optional[float] = None) -> None:
+        """The dial landed.  Starts the stability clock; does NOT
+        re-arm the schedule (see class docstring)."""
+        self._connected_at = (
+            time.monotonic() if now is None else now
+        )
+
+    def note_lost(self, now: Optional[float] = None) -> None:
+        """The stream died.  Re-arms the schedule only if the
+        connection survived ``stability_s`` — a flap continues the
+        capped schedule instead of restarting it."""
+        if now is None:
+            now = time.monotonic()
+        if (
+            self._connected_at is not None
+            and now - self._connected_at >= self.stability_s
+        ):
+            self.reset()
+        self._connected_at = None
 
 
 def backoff_rng(seed: Optional[int], node_id: str, peer_id: str) -> random.Random:

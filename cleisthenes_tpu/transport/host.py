@@ -390,6 +390,12 @@ class ValidatorHost:
         self._joining = joining
         self._addrs: Dict[str, str] = {}
         self._stopping = threading.Event()
+        # per-member dial backoffs persist across redial loops so a
+        # flapping link keeps its capped schedule instead of being
+        # re-probed from base on every transient success (see
+        # Backoff.note_lost); guarded by _backoffs_lock
+        self._backoffs: Dict[str, Backoff] = {}
+        self._backoffs_lock = threading.Lock()
         self.log = NodeLogger(node_id, "host")
         self._auth = HmacAuthenticator(node_id, keys.mac_keys)
         # inbound verification looks up the pair key by sender id, so
@@ -614,12 +620,24 @@ class ValidatorHost:
     def _backoff_for(self, member: str) -> Backoff:
         """One dial lane's backoff: Config policy + seeded jitter (the
         jitter de-synchronizes a roster all redialing the same dead
-        peer; the seed keeps fault tests replayable)."""
-        return Backoff(
-            self.config.dial_retry_base_s,
-            self.config.dial_retry_max_s,
-            rng=backoff_rng(self.config.seed, self.node_id, member),
-        )
+        peer; the seed keeps fault tests replayable).
+
+        The instance PERSISTS across redial loops: a flapping WAN link
+        (dial lands, stream dies before ``stability_s``) continues the
+        capped schedule rather than restarting from base on every
+        transient success — re-arming is stability-gated in
+        ``Backoff.note_lost``."""
+        with self._backoffs_lock:
+            b = self._backoffs.get(member)
+            if b is None:
+                b = self._backoffs[member] = Backoff(
+                    self.config.dial_retry_base_s,
+                    self.config.dial_retry_max_s,
+                    rng=backoff_rng(
+                        self.config.seed, self.node_id, member
+                    ),
+                )
+            return b
 
     def _dial_member(self, member: str):
         """Single dial attempt; raises on failure (retry policy is the
@@ -652,6 +670,7 @@ class ValidatorHost:
         conn.start()
         self.pool.add(conn)
         self.health.connected(member)
+        self._backoff_for(member).note_connected()
         return conn
 
     def _on_conn_lost(self, member: str, conn) -> None:
@@ -669,6 +688,7 @@ class ValidatorHost:
             self._closed_batches += getattr(conn, "mac_verify_batches", 0)
             self.pool.remove(member)
         self.health.stream_lost(member)
+        self._backoff_for(member).note_lost()
         self.log.warning("peer stream lost", peer=member)
         if self._stopping.is_set() or self.health.is_retired(member):
             return  # a retired peer's lost stream stays lost
@@ -739,6 +759,8 @@ class ValidatorHost:
         host stops generating redial storms the moment its duties
         end."""
         self.health.retire(member)
+        with self._backoffs_lock:
+            self._backoffs.pop(member, None)
         self._addrs.pop(member, None)
         if member in self.members:
             self.members = sorted(set(self.members) - {member})

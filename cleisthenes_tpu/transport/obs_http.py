@@ -364,6 +364,54 @@ def render_prometheus(targets: Sequence[ObsTarget]) -> str:
             labels,
             int(pipeline["eager_share_waves"]),
         )
+        # WAN emulation-plane counters (always present — zeroed on
+        # real transports / unmounted profiles per the schema rule)
+        wan = snap["wan"]
+        exp.add(
+            exp.family(
+                "wan_enabled", "gauge",
+                "1 while a seeded WAN link-model profile is mounted "
+                "on the channel transport",
+            ),
+            labels,
+            int(wan["enabled"]),
+        )
+        exp.add(
+            exp.family(
+                "wan_frames_delayed_total", "counter",
+                "frames priced past their admission instant by the "
+                "link model (latency/loss/bandwidth/straggler)",
+            ),
+            labels,
+            int(wan["frames_delayed"]),
+        )
+        exp.add(
+            exp.family(
+                "wan_retransmits_total", "counter",
+                "emulated reliable-transport retransmissions (each "
+                "seeded loss adds one RTO to the delivery deadline)",
+            ),
+            labels,
+            int(wan["retransmits"]),
+        )
+        exp.add(
+            exp.family(
+                "wan_straggler_episodes_total", "counter",
+                "heavy-tailed straggler episodes started across the "
+                "roster's node processes",
+            ),
+            labels,
+            int(wan["straggler_episodes"]),
+        )
+        exp.add(
+            exp.family(
+                "wan_virtual_time_seconds", "gauge",
+                "the emulation plane's virtual clock (never wall "
+                "time; advances only at delivery deadlines)",
+            ),
+            labels,
+            int(wan["virtual_time_ms"]) / 1e3,
+        )
         for peer, ph in snap.get("transport_health", {}).items():
             plabels = {**labels, "peer": peer}
             exp.add(

@@ -45,6 +45,29 @@ def proposal_rng(seed: Optional[int], node_id: str) -> random.Random:
     return random.Random(f"{seed}|{node_id}")
 
 
+def wan_rng(seed: Optional[int], *lane: str) -> random.Random:
+    """The audited entropy fork for the WAN emulation plane
+    (transport/wan.py — in the determinism plane: its draws decide
+    delivery order, which decides ledger bytes under a seeded
+    schedule).
+
+    Every independent stream in the emulator — one per link, one per
+    node straggler process — names itself with a ``lane`` tuple, e.g.
+    ``wan_rng(seed, "link", sender, receiver)``.  Keying streams by
+    name (not by creation order) makes the whole plane insensitive to
+    lazy-construction order: a link first touched by a metrics scrape
+    draws the same delays as one first touched by a frame.
+
+    ``seed=None`` (production): SystemRandom — emulated delays are
+    unpredictable, replay is not claimed.  With a seed: a pure
+    function of (seed, lane), byte-identical across processes and
+    PYTHONHASHSEED values.
+    """
+    if seed is None:
+        return random.SystemRandom()
+    return random.Random(f"{seed}|wan|{'|'.join(lane)}")
+
+
 def guarded_by(lock_attr: str, *attrs: str):
     """Class decorator declaring ``attrs`` as protected by
     ``self.<lock_attr>``.
@@ -67,4 +90,4 @@ def guarded_by(lock_attr: str, *attrs: str):
     return deco
 
 
-__all__ = ["proposal_rng", "guarded_by"]
+__all__ = ["proposal_rng", "wan_rng", "guarded_by"]

@@ -286,6 +286,11 @@ class Metrics:
         # (proposed, consensus live, not yet ordered) — the K-deep
         # window's in-flight gauge, 1 in steady lockstep
         self._pipeline: Optional[Callable[[], int]] = None
+        # WAN-emulation provider (set by the owning cluster when
+        # SimulatedCluster(wan_profile=) mounts a link model;
+        # WanEmulator.stats): folds the virtual-clock plane's tallies
+        # into snapshot()["wan"]
+        self._wan_stats: Optional[Callable[[], Dict]] = None
 
     def set_transport_health(
         self, provider: Optional[Callable[[], Dict]]
@@ -322,6 +327,12 @@ class Metrics:
     def set_pipeline(self, provider: Optional[Callable[[], int]]) -> None:
         """Epochs-in-flight provider (K-deep pipelined frontiers)."""
         self._pipeline = provider
+
+    def set_wan_stats(
+        self, provider: Optional[Callable[[], Dict]]
+    ) -> None:
+        """WAN emulation-plane provider (WanEmulator.stats)."""
+        self._wan_stats = provider
 
     def decrypt_lag_epochs(self) -> int:
         """Ordered frontier - settled frontier (0 when no provider is
@@ -501,6 +512,21 @@ class Metrics:
         if self._hub_stats is not None:
             hub.update(self._hub_stats())
         out["hub"] = hub
+        # WAN-emulation block: ALWAYS present with every key, zeroed
+        # on real transports / unmounted profiles (the PR-9 schema
+        # rule); with SimulatedCluster(wan_profile=) the emulator's
+        # provider overwrites with the virtual-clock plane's tallies
+        wan: Dict[str, object] = {
+            "enabled": 0,
+            "profile": "",
+            "frames_delayed": 0,
+            "retransmits": 0,
+            "straggler_episodes": 0,
+            "virtual_time_ms": 0,
+        }
+        if self._wan_stats is not None:
+            wan.update(self._wan_stats())
+        out["wan"] = wan
         if self._transport_health is not None:
             out["transport_health"] = self._transport_health()
         if self._trace_stats is not None:

@@ -51,6 +51,11 @@ from cleisthenes_tpu.utils.metrics import Metrics
 UP = "up"
 DEGRADED = "degraded"
 DOWN = "down"
+# a peer-LINK state (not a health verdict): the transport reports the
+# peer alive but inside a WAN straggler episode.  Counts as non-UP for
+# the DEGRADED scan, never as DOWN — a slow honest node is the one
+# failure mode a BFT watchdog must not escalate (ISSUE 16).
+STRAGGLING = "straggling"
 
 # detector names (the ``alert=`` label vocabulary of the exposition)
 EPOCH_STALL = "epoch_stall"
@@ -85,9 +90,10 @@ class SloWatchdog:
         stall_grace_s: float = 10.0,
         queue_depth_limit: int = 100_000,
         peer_lag_epochs: int = 8,
-        peer_states_fn: Optional[Callable[[], Dict[str, str]]] = None,
+        peer_states_fn: Optional[Callable[[], Dict[str, object]]] = None,
         peer_lag_fn: Optional[Callable[[], Dict[str, int]]] = None,
         decrypt_lag_budget: int = 4,
+        budget_floor_fn: Optional[Callable[[], float]] = None,
         trace=None,
     ) -> None:
         if stall_factor <= 0 or stall_grace_s <= 0:
@@ -110,6 +116,13 @@ class SloWatchdog:
         # at — read via metrics.decrypt_lag_epochs() (zero on the
         # coupled path, so the detector is inert there).
         self.decrypt_lag_budget = decrypt_lag_budget
+        # transport-aware leash floor (ISSUE 16): when the transport
+        # prices links like a WAN profile, a p50 self-calibrated on
+        # fast epochs must not flip DOWN the moment the tail of the
+        # link-delay distribution lands — the floor provider (e.g.
+        # WanEmulator.stall_floor_s) raises the budget's lower bound
+        # to what the mounted link model can legitimately cost
+        self._budget_floor = budget_floor_fn
         self.trace = trace
         self._alerts: Dict[str, _Alert] = {
             name: _Alert(name)
@@ -125,13 +138,18 @@ class SloWatchdog:
     # -- detectors ---------------------------------------------------------
 
     def stall_budget_s(self) -> float:
-        """The commit-progress SLO: ``max(grace, factor * epoch p50)``
-        — derived from this node's own recent latency, so the leash
-        scales with roster size and batch weight."""
+        """The commit-progress SLO: ``max(grace, factor * epoch p50,
+        transport floor)`` — derived from this node's own recent
+        latency, so the leash scales with roster size and batch
+        weight; the optional transport floor keeps a LAN-calibrated
+        p50 from flipping DOWN under WAN-priced links."""
+        floor = 0.0
+        if self._budget_floor is not None:
+            floor = self._budget_floor()
         p50 = self._metrics.epoch_latency.p50
         if p50 is None:
-            return self.stall_grace_s
-        return max(self.stall_grace_s, self.stall_factor * p50)
+            return max(self.stall_grace_s, floor)
+        return max(self.stall_grace_s, self.stall_factor * p50, floor)
 
     def check(self, now: Optional[float] = None) -> str:
         """Evaluate every detector once; returns the health verdict.
@@ -182,12 +200,29 @@ class SloWatchdog:
         )
         return self.health()
 
+    def _peer_state_map(self) -> Dict[str, str]:
+        """Peer -> link-state string.  The provider may return plain
+        strings (gRPC PeerHealthTracker) or per-link dicts with a
+        ``state`` field (ChannelNetwork.link_states with its WAN
+        model fields) — both transports feed the same detector."""
+        if self._peer_states is None:
+            return {}
+        out: Dict[str, str] = {}
+        for peer, state in self._peer_states().items():
+            if isinstance(state, dict):
+                state = state.get("state", UP)
+            out[peer] = str(state)
+        return out
+
     def _lagging_peers(self) -> List[str]:
         out: List[str] = []
         if self._peer_states is not None:
+            # DOWN only: a STRAGGLING peer is alive and must degrade,
+            # not alert — the epoch-gap clause below still catches it
+            # if it genuinely falls behind the roster
             out.extend(
                 peer
-                for peer, state in sorted(self._peer_states().items())
+                for peer, state in sorted(self._peer_state_map().items())
                 if state == DOWN
             )
         if self._peer_lag is not None:
@@ -231,7 +266,7 @@ class SloWatchdog:
             degraded = any(a.active for a in self._alerts.values())
         if not degraded and self._peer_states is not None:
             degraded = any(
-                state != UP for state in self._peer_states().values()
+                state != UP for state in self._peer_state_map().values()
             )
         return DEGRADED if degraded else UP
 
@@ -262,6 +297,7 @@ __all__ = [
     "UP",
     "DEGRADED",
     "DOWN",
+    "STRAGGLING",
     "EPOCH_STALL",
     "QUEUE_BACKPRESSURE",
     "PEER_LAG",

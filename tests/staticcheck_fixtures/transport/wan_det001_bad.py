@@ -1,0 +1,20 @@
+"""Known-bad DET001 corpus for the WAN stem rule: a ``transport/``
+file whose stem starts with ``wan_`` is part of the determinism plane
+(tools/staticcheck/core.py FileContext) — raw entropy or wall-clock in
+a link model would silently break byte-identical replay of a seeded
+WAN schedule, so the same DET001 bans gate here as in protocol/."""
+
+import random
+import time
+
+
+def jittered_owd(rtt_s: float) -> float:
+    return rtt_s / 2 * (1.0 + 0.25 * random.random())  # BAD:DET001
+
+
+def link_rng() -> random.Random:
+    return random.Random()  # BAD:DET001
+
+
+def deadline() -> float:
+    return time.monotonic() + 0.5  # BAD:DET001

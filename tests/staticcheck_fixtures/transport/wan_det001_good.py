@@ -1,0 +1,16 @@
+"""Known-good DET001 corpus for the WAN stem rule: link-model entropy
+drawn through the audited utils.determinism doorway replays
+byte-identically for a fixed seed."""
+
+import random
+from typing import Optional
+
+from cleisthenes_tpu.utils.determinism import wan_rng
+
+
+def link_rng(seed: Optional[int], sender: str, receiver: str) -> random.Random:
+    return wan_rng(seed, "link", sender, receiver)
+
+
+def jittered_owd(rng: random.Random, rtt_s: float) -> float:
+    return rtt_s / 2 * (1.0 + 0.25 * rng.random())

@@ -203,6 +203,21 @@ def test_fuzz_pipeline_deep_sweep():
 
 
 @pytest.mark.slow
+def test_fuzz_wan_deep_sweep():
+    """The WAN emulation deep band (ISSUE 16): 200 sampled composite
+    schedules over the seeded link-model plane — the profile (lan /
+    wan_3region / wan_global / straggler_tail / lossy) is itself
+    drawn from the seed, so latency, jitter, loss-retransmission,
+    bandwidth serialization and heavy-tailed straggler episodes all
+    reshape delivery order — and every safety and liveness invariant
+    must hold (ci.sh runs the 0:20 smoke band of this sampler; this
+    is the RUN-SLOW extension)."""
+    for seed in range(20, 220):
+        v = run_schedule(sample_schedule(seed, wan=True))
+        assert v is None, f"seed {seed}: {v}"
+
+
+@pytest.mark.slow
 def test_fuzz_reconfig_deep_sweep():
     """The dynamic-membership deep band: 200 reconfig-bearing
     schedules — every sampled crash/partition/semantic composite runs

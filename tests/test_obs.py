@@ -254,6 +254,18 @@ def _golden_target() -> ObsTarget:
     m.set_hub_stats(
         lambda: {"coin_share_batches": 2, "coin_share_items": 9}
     )
+    # WAN emulation-plane counters (ISSUE 16): zeroed keys on every
+    # path; pinned nonzero so the golden scrape covers the families
+    m.set_wan_stats(
+        lambda: {
+            "enabled": 1,
+            "profile": "wan_3region",
+            "frames_delayed": 11,
+            "retransmits": 2,
+            "straggler_episodes": 1,
+            "virtual_time_ms": 1500,
+        }
+    )
     m.set_transport_health(
         lambda: {
             'peer"q\\s': {

@@ -107,6 +107,10 @@ BAD_FIXTURES = [
     "protocol/conc001_bad.py",
     "transport/conc002_bad.py",
     "protocol/err001_bad.py",
+    # the WAN stem rule (ISSUE 16): transport files named wan/wan_*
+    # join the determinism plane, so raw random/wall-clock in a link
+    # model gates — seeded WAN schedules must replay byte-identically
+    "transport/wan_det001_bad.py",
 ]
 GOOD_FIXTURES = [
     "protocol/det001_good.py",
@@ -123,6 +127,7 @@ GOOD_FIXTURES = [
     "protocol/conc001_good.py",
     "transport/conc002_good.py",
     "protocol/err001_good.py",
+    "transport/wan_det001_good.py",
     "protocol/pragma_file_cases.py",
 ]
 

@@ -101,6 +101,8 @@ def sample_schedule(
     rounds: int = 12,
     reconfig: bool = False,
     pipeline_depth: Optional[int] = None,
+    wan: bool = False,
+    wan_profile: Optional[str] = None,
 ) -> dict:
     """One composite fault schedule, a pure function of ``seed``.
 
@@ -119,7 +121,13 @@ def sample_schedule(
     ``pipeline_depth`` pins the K-deep protocol-plane window (the
     ci.sh depth band); None draws it from the seed (LAST, so the
     depth key extends the historical schedule stream instead of
-    reshuffling it), spanning lockstep and pipelined windows."""
+    reshuffling it), spanning lockstep and pipelined windows.
+
+    ``wan=True`` (the WAN band, ISSUE 16) mounts a seeded link-delay
+    profile on the channel scheduler — drawn from the seed AFTER
+    every other key (the same append-LAST rule as depth, so the WAN
+    band's schedules extend the historical stream), or pinned with
+    ``wan_profile``."""
     rng = random.Random(seed)
     f = (n - 1) // 3
     ids = [f"node{i:03d}" for i in range(n)]
@@ -187,8 +195,16 @@ def sample_schedule(
         # invariants must hold over every window width, so depth is
         # part of the sampled schedule space
         pipeline_depth = rng.choice((1, 2, 4))
+    if wan and wan_profile is None:
+        # WAN link-delay plane (ISSUE 16): drawn LAST — the newest
+        # appended key, after depth — so non-WAN replays of historical
+        # seeds are untouched and WAN-band schedules share every other
+        # draw with their non-WAN twins
+        from cleisthenes_tpu.transport.wan import wan_profile_names
 
-    return {
+        wan_profile = rng.choice(wan_profile_names())
+
+    out = {
         "version": SCHEDULE_VERSION,
         "seed": seed,
         "pipeline_depth": pipeline_depth,
@@ -204,6 +220,9 @@ def sample_schedule(
         "timeline": timeline,
         "check_liveness": True,
     }
+    if wan_profile is not None:
+        out["wan_profile"] = wan_profile
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -249,6 +268,9 @@ def _build_cluster(schedule: dict, trace: bool) -> SimulatedCluster:
         seed=schedule["seed"],
         key_seed=schedule["key_seed"],
         behaviors=behaviors,
+        # WAN band (ISSUE 16): the schedule key mounts the seeded
+        # link-delay profile; absent on historical schedules
+        wan_profile=schedule.get("wan_profile"),
     )
     if schedule["wire"]:
         coal = Coalition(schedule["bad"], seed=schedule["seed"])
@@ -571,6 +593,8 @@ def fuzz_seeds(
     trace: bool = True,
     reconfig: bool = False,
     pipeline_depth: Optional[int] = None,
+    wan: bool = False,
+    wan_profile: Optional[str] = None,
 ) -> int:
     """Run a schedule per seed; on the first violation, shrink it and
     emit a repro file plus (by default) a flight-recorder trace
@@ -585,6 +609,8 @@ def fuzz_seeds(
             rounds=rounds,
             reconfig=reconfig,
             pipeline_depth=pipeline_depth,
+            wan=wan,
+            wan_profile=wan_profile,
         )
         violation = run_schedule(schedule)
         if violation is None:
@@ -628,6 +654,19 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         "default draws depth from the seed",
     )
     ap.add_argument(
+        "--wan",
+        action="store_true",
+        help="WAN band: mount a seeded link-delay profile "
+        "(transport.wan.PROFILES) drawn from each seed, appended "
+        "LAST so historical seed streams extend",
+    )
+    ap.add_argument(
+        "--wan-profile",
+        default=None,
+        help="pin one named WAN profile instead of drawing it from "
+        "the seed (implies --wan)",
+    )
+    ap.add_argument(
         "--show", action="store_true", help="print the schedule, no run"
     )
     ap.add_argument("--repro", help="replay a repro file")
@@ -660,12 +699,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         ap.error("need --seed, --seeds or --repro")
         return 2
 
+    wan = args.wan or args.wan_profile is not None
     if args.show:  # print the sampled schedule(s), run nothing
         for seed in seeds:
             schedule = sample_schedule(
                 seed, n=args.n, rounds=args.rounds,
                 reconfig=args.reconfig,
                 pipeline_depth=args.pipeline_depth,
+                wan=wan,
+                wan_profile=args.wan_profile,
             )
             json.dump(schedule, sys.stdout, indent=2, sort_keys=True)
             print()
@@ -678,6 +720,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         trace=not args.no_trace,
         reconfig=args.reconfig,
         pipeline_depth=args.pipeline_depth,
+        wan=wan,
+        wan_profile=args.wan_profile,
     )
 
 

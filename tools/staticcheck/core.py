@@ -90,9 +90,18 @@ class FileContext:
         self.text = path.read_text(encoding="utf-8")
         self.lines = self.text.splitlines()
         self.tree = ast.parse(self.text, filename=self.relpath)
-        parts = frozenset(pathlib.PurePosixPath(self.relpath).parts)
-        self.in_plane = bool(parts & PLANE_DIRS)
+        ppath = pathlib.PurePosixPath(self.relpath)
+        parts = frozenset(ppath.parts)
         self.in_transport = bool(parts & TRANSPORT_DIRS)
+        # WAN emulation modules live under transport/ but are part of
+        # the determinism plane: every delay/loss/straggler draw must
+        # come through utils.determinism (byte-identical replay for a
+        # fixed seed), so DET rules gate transport files whose stem is
+        # ``wan`` or ``wan_*`` exactly like protocol/core/ops code
+        wan_stem = ppath.stem == "wan" or ppath.stem.startswith("wan_")
+        self.in_plane = bool(parts & PLANE_DIRS) or (
+            self.in_transport and wan_stem
+        )
         self._aliases = _import_aliases(self.tree)
 
     def resolve(self, node: ast.AST) -> Optional[str]:
