@@ -9,13 +9,16 @@
 #      image bakes no third-party formatter; the gate enforces this
 #      tree's deterministic style invariants — parseability, LF, EOF
 #      newline, no tabs/trailing whitespace, <= 99 cols — stdlib-only)
-#   2. staticcheck gate    — tools/staticcheck: the two-pass
+#   2. staticcheck gate    — tools/staticcheck: the three-pass
 #      whole-program analyzer over the package + tools + tests
-#      (per-file rules DET001-DET006/CONC001/CONC002/ERR001 plus the
+#      (per-file rules DET001-DET006/CONC001/CONC002/ERR001, the
 #      cross-module registry rules WIRE001 wire-kind/pb-tag coverage,
 #      SCHEMA001 counter/snapshot/golden-exposition parity, ARM001
 #      arm-flag/wave-seam/fingerprint parity, VERIFY001
-#      verify-before-dispatch taint walk), with --audit-pragmas
+#      verify-before-dispatch taint walk, plus the pass-3 call-graph
+#      rules CONC003 caller-holds-lock discipline, CONC004 blocking
+#      reachability from dispatcher callbacks, DET007 interprocedural
+#      entropy taint), with --audit-pragmas
 #      failing on stale pragmas and pragma-count growth past the
 #      budget in baseline.json.  Fails on ANY unbaselined finding;
 #      the committed baseline is empty — every sanctioned exception
@@ -39,17 +42,22 @@
 #      (transport/byzantine), this stack's answer to `-race`
 #      (SURVEY.md §5.2: replayable interleavings instead of a dynamic
 #      race detector), plus the real-thread gRPC suite
-#   7. fault tier          — the crash/partition/adversary suite
+#   7. lock sanitizer      — the lock-sensitive tier-1 subset +
+#      a 20-seed fuzz band re-run under CLEISTHENES_LOCKCHECK=1: the
+#      runtime @guarded_by sanitizer (utils/lockcheck.py, the dynamic
+#      twin of CONC001/CONC003) asserts every guarded attribute
+#      access holds its declared lock; zero violations gate
+#   8. fault tier          — the crash/partition/adversary suite
 #      (`-m faults`: Byzantine coalitions, crash+WAL-restart+CATCHUP,
 #      gRPC backoff redial) replayed over a fixed 3-seed matrix, so a
 #      fault-handling regression on ANY matrix seed gates the merge
-#   8. fuzz smoke          — tools/fuzz.py over a fixed seed band:
+#   9. fuzz smoke          — tools/fuzz.py over a fixed seed band:
 #      composite semantic (protocol/byzantine) + wire (Coalition) +
 #      crash/partition schedules on seeded 4-node clusters, safety
 #      invariants checked at every quiescence point; a violation
 #      shrinks to a minimal replayable repro.  The deep band (200
 #      seeds) rides the slow tier (tests/test_fuzz.py)
-#   9. full tier           — everything, including the N=64 slow test
+#  10. full tier           — everything, including the N=64 slow test
 #      (skipped when CI_FAST=1)
 #
 # Usage:  ./ci.sh          # full gate
@@ -58,35 +66,49 @@
 set -euo pipefail
 cd "$(dirname "$0")"
 
-echo "== [1/9] syntax + format gate"
+echo "== [1/10] syntax + format gate"
 python -m compileall -q cleisthenes_tpu tests bench.py __graft_entry__.py
 python tools/format_gate.py
 
-echo "== [2/9] staticcheck gate: whole-program registry + determinism plane"
+echo "== [2/10] staticcheck gate: whole-program registry + determinism plane"
 python -m tools.staticcheck cleisthenes_tpu tools tests --audit-pragmas
 
-echo "== [3/9] observability gate: traced seeded cluster -> tracetool --validate"
+echo "== [3/10] observability gate: traced seeded cluster -> tracetool --validate"
 TRACE_ARTIFACT="$(mktemp /tmp/cleisthenes_trace_ci.XXXXXX.json)"
 trap 'rm -f "$TRACE_ARTIFACT"' EXIT
 JAX_PLATFORMS=cpu python -m tools.tracetool \
     --capture "$TRACE_ARTIFACT" --n 4 --seed 7 --txs 24
 python -m tools.tracetool "$TRACE_ARTIFACT" --validate
 
-echo "== [4/9] perf-regression gate: seeded mini-bench vs BENCH_TREND.jsonl"
+echo "== [4/10] perf-regression gate: seeded mini-bench vs BENCH_TREND.jsonl"
 # seeded traced mini-bench through tools/perfgate.py; seeds the trend
 # on the first run, gates epoch-p50 / dispatch-count / stage-share
 # regressions (noise-banded) on every later run and appends on pass
 JAX_PLATFORMS=cpu python -m tools.perfgate --trend BENCH_TREND.jsonl
 
-echo "== [5/9] fast tests (with coverage gate)"
+echo "== [5/10] fast tests (with coverage gate)"
 COVGATE_MIN="${COVGATE_MIN:-85}" \
     python -m pytest tests/ -q -m "not slow" -x -p tools.covgate
 
-echo "== [6/9] race-analog: seeded-scheduler + threaded-transport suites"
+echo "== [6/10] race-analog: seeded-scheduler + threaded-transport suites"
 python -m pytest tests/test_transport.py tests/test_byzantine.py \
     tests/test_semantic_byzantine.py tests/test_grpc.py -q -x -m "not slow"
 
-echo "== [7/9] fault gate: crash/partition/adversary suite, 3-seed matrix"
+echo "== [7/10] lock sanitizer: @guarded_by runtime assertions armed"
+# the same annotation registry staticcheck proves statically, watched
+# dynamically: every guarded attribute access must hold its declared
+# lock (utils/lockcheck.py); the lock-sensitive suites + one fuzz
+# band run armed, so a discipline hole the static rules cannot see
+# (dynamic dispatch, callbacks) still gates
+CLEISTHENES_LOCKCHECK=1 python -m pytest tests/test_transport.py \
+    tests/test_hub.py tests/test_ledger.py tests/test_lockcheck.py \
+    -q -x -m "not slow"
+LOCKCHECK_FUZZ_OUT="$(mktemp -d /tmp/cleisthenes_fuzz_lc.XXXXXX)"
+CLEISTHENES_LOCKCHECK=1 JAX_PLATFORMS=cpu python -m tools.fuzz \
+    --seeds 0:20 --out "$LOCKCHECK_FUZZ_OUT"
+rm -rf "$LOCKCHECK_FUZZ_OUT"
+
+echo "== [8/10] fault gate: crash/partition/adversary suite, 3-seed matrix"
 # the full faults-marked suite already ran at the default seed in
 # stages 4-5; the matrix replays the FAULT_SEED-parametrized
 # crash+WAL-restart+CATCHUP scenario (the seed-sensitive entry point)
@@ -97,7 +119,7 @@ for seed in 11 23 47; do
         -m faults -k crash_restart_wal_catchup
 done
 
-echo "== [8/9] fuzz smoke: semantic+wire schedule fuzzer, 20-seed band"
+echo "== [9/10] fuzz smoke: semantic+wire schedule fuzzer, 20-seed band"
 # 4-node seeded clusters, composite behavior/wire/crash schedules;
 # any invariant violation exits non-zero, leaving the shrunken repro
 # + trace artifact in FUZZ_OUT (cleaned only on success)
@@ -131,9 +153,9 @@ JAX_PLATFORMS=cpu python -m tools.fuzz --seeds 0:20 --wan \
 rm -rf "$FUZZ_OUT"
 
 if [[ "${CI_FAST:-0}" == "1" ]]; then
-    echo "== [9/9] skipped (CI_FAST=1)"
+    echo "== [10/10] skipped (CI_FAST=1)"
 else
-    echo "== [9/9] full suite incl. scale tests"
+    echo "== [10/10] full suite incl. scale tests"
     python -m pytest tests/ -q -m slow
 fi
 

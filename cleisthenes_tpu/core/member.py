@@ -17,10 +17,10 @@ construction-time ``Config``.
 from __future__ import annotations
 
 import dataclasses
-import threading
 from typing import Dict, List, Optional, Tuple
 
 from cleisthenes_tpu.utils.determinism import guarded_by
+from cleisthenes_tpu.utils.lockcheck import new_rlock
 
 
 @dataclasses.dataclass(frozen=True, order=True)
@@ -157,7 +157,7 @@ class MemberMap:
 
     def __init__(self) -> None:
         self._members: Dict[str, Member] = {}
-        self._lock = threading.RLock()
+        self._lock = new_rlock()
 
     def add(self, member: Member) -> None:
         with self._lock:

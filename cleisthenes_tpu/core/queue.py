@@ -11,10 +11,10 @@ opaque to the framework (reference honeybadger.go:115
 from __future__ import annotations
 
 import collections
-import threading
 from typing import Any, Deque
 
 from cleisthenes_tpu.utils.determinism import guarded_by
+from cleisthenes_tpu.utils.lockcheck import new_lock
 
 # A transaction is opaque to the consensus core (honeybadger.go:115).
 Transaction = Any
@@ -42,7 +42,7 @@ class TxQueue:
 
     def __init__(self) -> None:
         self._txs: Deque[Transaction] = collections.deque()
-        self._lock = threading.Lock()
+        self._lock = new_lock()
 
     def push(self, tx: Transaction) -> None:
         """Append a transaction (reference queue.go:89-94)."""

@@ -10,10 +10,10 @@ wired at bba/bba.go:55).
 
 from __future__ import annotations
 
-import threading
 from typing import Any, Dict, List, Tuple
 
 from cleisthenes_tpu.utils.determinism import guarded_by
+from cleisthenes_tpu.utils.lockcheck import new_lock
 
 Request = Any  # marker interface (reference request.go:3-5)
 
@@ -36,7 +36,7 @@ class RequestRepository:
 
     def __init__(self) -> None:
         self._reqs: Dict[str, Request] = {}
-        self._lock = threading.Lock()
+        self._lock = new_lock()
 
     def save(self, conn_id: str, req: Request) -> None:
         with self._lock:
@@ -79,7 +79,7 @@ class IncomingRequestRepository:
         self._max_epoch_horizon = max_epoch_horizon
         self._max_per_sender = max_per_sender
         self._reqs: Dict[int, Dict[str, List[Request]]] = {}
-        self._lock = threading.Lock()
+        self._lock = new_lock()
         self.dropped = 0
 
     def save(

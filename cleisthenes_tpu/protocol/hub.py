@@ -80,6 +80,8 @@ from cleisthenes_tpu.ops.tpke import (
     issue_shares_batch,
     verify_share_groups,
 )
+from cleisthenes_tpu.utils.determinism import guarded_by
+from cleisthenes_tpu.utils.lockcheck import new_lock
 from cleisthenes_tpu.utils.memo import BoundedFifoMemo
 
 # A flush settles in 1-2 wave rounds (branch verdicts unlock decodes
@@ -196,6 +198,7 @@ class HubWave:
         return out
 
 
+@guarded_by("_dec_lock", "_dec_pool", "_dec_results")
 class CryptoHub:
     """Per-node batched-crypto service shared by all protocol instances.
 
@@ -299,6 +302,10 @@ class CryptoHub:
         # dispatch and one CP-nonce draw, not one per node per epoch.
         self.dec_issue_batches = 0
         self.dec_issue_items = 0
+        # guarded: a cluster-SHARED hub serves every node's stage/
+        # drain calls, and the ISSUE-17 sweep requires the column's
+        # pool+results to move under one declared lock
+        self._dec_lock = new_lock()
         self._dec_pool: List[Tuple] = []  # (owner, meta, item, group)
         self._dec_results: Dict[object, List[Tuple]] = {}
         # per-flush total column width (branch+decode+share items) of
@@ -764,7 +771,8 @@ class CryptoHub:
         epoch ORDERS — during the message wave — so by the turn's
         piggyback drain every node's (and every freshly ordered
         epoch's) wants are pooled."""
-        self._dec_pool.append((owner, meta, item, group))
+        with self._dec_lock:
+            self._dec_pool.append((owner, meta, item, group))
 
     def take_dec_issues(self, owner) -> List[Tuple]:
         """``(meta, DhShare)`` rows for ``owner``, in stage order.
@@ -774,11 +782,12 @@ class CryptoHub:
         TPKE group is deployment-wide), and each other owner's
         shares park until its own drain claims them, so broadcast
         site and order stay per-node deterministic."""
-        if any(row[0] is owner for row in self._dec_pool):
-            self._run_dec_pool()
-        return self._dec_results.pop(owner, [])
+        with self._dec_lock:
+            if any(row[0] is owner for row in self._dec_pool):
+                self._run_dec_pool_locked()
+            return self._dec_results.pop(owner, [])
 
-    def _run_dec_pool(self) -> None:
+    def _run_dec_pool_locked(self) -> None:
         pool, self._dec_pool = self._dec_pool, []
 
         def tally(n: int) -> None:

@@ -86,6 +86,14 @@ class WaveRouter:
     node's epoch window through ``_epoch_state`` and dispatches into
     the same protocol objects the scalar chain reaches — it changes
     HOW MANY Python calls carry a wave, never what state they write.
+
+    Lock audit (ISSUE 17): deliberately unlocked.  The router holds no
+    mutable state of its own (``__slots__`` is one back-pointer) and
+    ``serve_wave``/``route`` run only on the dispatcher thread that
+    serializes ALL protocol mutation; a ``@guarded_by`` here would
+    declare a lock no second thread can ever contend.  The
+    interprocedural sweep (CONC003/CONC004) confirms: no ``*_locked``
+    callee and no blocking call is reachable from ``route``.
     """
 
     __slots__ = ("_hb",)

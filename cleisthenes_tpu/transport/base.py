@@ -20,7 +20,6 @@ from __future__ import annotations
 import abc
 import hashlib
 import hmac
-import threading
 from typing import Callable, Dict, List, Optional, Protocol, runtime_checkable
 
 from cleisthenes_tpu.transport.message import (
@@ -29,6 +28,7 @@ from cleisthenes_tpu.transport.message import (
     signing_bytes,
 )
 from cleisthenes_tpu.utils.determinism import guarded_by
+from cleisthenes_tpu.utils.lockcheck import new_rlock
 
 
 @runtime_checkable
@@ -473,7 +473,7 @@ class ConnectionPool:
 
     def __init__(self) -> None:
         self._conns: Dict[str, Connection] = {}
-        self._lock = threading.RLock()
+        self._lock = new_rlock()
 
     def add(self, conn: Connection) -> None:
         with self._lock:

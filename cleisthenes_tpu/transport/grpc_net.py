@@ -42,6 +42,7 @@ from cleisthenes_tpu.transport.message import (
     encode_message,
 )
 from cleisthenes_tpu.utils.determinism import guarded_by
+from cleisthenes_tpu.utils.lockcheck import new_lock
 
 SERVICE_NAME = "cleisthenes.StreamService"
 METHOD_NAME = "MessageStream"
@@ -370,7 +371,7 @@ class GrpcServer:
         self._on_err: Optional[ErrHandler] = None
         self._server: Optional[grpc.Server] = None
         self._conns: List[GrpcConnection] = []
-        self._lock = threading.Lock()
+        self._lock = new_lock()
         self.port: Optional[int] = None
         # counters folded in from closed connections, so stats() stays
         # cumulative across redials

@@ -26,11 +26,11 @@ State machine (per peer):
 from __future__ import annotations
 
 import random
-import threading
 import time
 from typing import Dict, List, Optional
 
 from cleisthenes_tpu.utils.determinism import guarded_by
+from cleisthenes_tpu.utils.lockcheck import new_lock
 
 # canonical UP/DEGRADED/DOWN vocabulary lives in utils/watchdog.py;
 # dial health and SLO verdicts must stay comparable (host peer states
@@ -172,7 +172,7 @@ class PeerHealthTracker:
         # row and the backoff loop would hammer a host that is GONE,
         # forever (the redial-storm the retirement satellite kills)
         self._retired: set = set()
-        self._lock = threading.Lock()
+        self._lock = new_lock()
 
     def _peer_locked(self, peer_id: str) -> _PeerHealth:
         """Lookup-or-create; caller holds ``_lock`` (CONC001 naming

@@ -50,6 +50,7 @@ from cleisthenes_tpu.transport.message import (
     payload_body_count,
 )
 from cleisthenes_tpu.utils.determinism import guarded_by
+from cleisthenes_tpu.utils.lockcheck import new_lock
 from cleisthenes_tpu.utils.log import NodeLogger
 
 
@@ -222,7 +223,7 @@ class GrpcPayloadBroadcaster:
         # not dialed yet (protocol messages are sent exactly once)
         self._ready = False
         self._pending: List = []
-        self._lock = threading.Lock()
+        self._lock = new_lock()
         # Columnar egress (Config.egress_columnar): the coalescer
         # hands each flush's whole wave to post_wave, which signs it
         # in ONE Authenticator.sign_wire_wave pass (payload bodies
@@ -395,7 +396,7 @@ class ValidatorHost:
         # re-probed from base on every transient success (see
         # Backoff.note_lost); guarded by _backoffs_lock
         self._backoffs: Dict[str, Backoff] = {}
-        self._backoffs_lock = threading.Lock()
+        self._backoffs_lock = new_lock()
         self.log = NodeLogger(node_id, "host")
         self._auth = HmacAuthenticator(node_id, keys.mac_keys)
         # inbound verification looks up the pair key by sender id, so
@@ -419,7 +420,7 @@ class ValidatorHost:
         # frame counters of dialed streams that have since been lost:
         # folded in at loss time so the transport metric stays
         # cumulative across self-healing redials
-        self._closed_stats_lock = threading.Lock()
+        self._closed_stats_lock = new_lock()
         self._closed_delivered = 0
         self._closed_rejected = 0
         self._closed_decoded = 0

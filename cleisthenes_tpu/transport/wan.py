@@ -41,7 +41,8 @@ from __future__ import annotations
 import dataclasses
 from typing import Dict, Optional, Tuple, Union
 
-from cleisthenes_tpu.utils.determinism import wan_rng
+from cleisthenes_tpu.utils.determinism import guarded_by, wan_rng
+from cleisthenes_tpu.utils.lockcheck import new_lock
 
 # an episode's Pareto tail is capped so one draw cannot freeze a link
 # for the whole schedule (virtual seconds)
@@ -231,6 +232,7 @@ class LinkModel:
         self.busy_until = 0.0  # bandwidth serialization horizon
 
 
+@guarded_by("_lock", "_links", "_regions", "_stragglers")
 class WanEmulator:
     """The virtual clock + the lazy per-link / per-node model maps.
 
@@ -238,6 +240,14 @@ class WanEmulator:
     enqueue time and ``advance`` when the visible queue drains.  All
     state is keyed by name (node id, ordered pair), never by
     construction order, so observability reads cannot perturb replay.
+
+    The lazy model maps are guarded: ``admit`` runs on the scheduler
+    thread while ``stats``/``link_info`` serve the metrics scrape
+    thread, and an unguarded lazy fill racing a scrape iteration is a
+    dict-mutation error at best and a silently forked model at worst
+    (the ISSUE-17 interprocedural sweep surfaced exactly this).  The
+    virtual clock and the two delay counters stay unguarded: they are
+    scalar monotone values read opportunistically by observers.
     """
 
     def __init__(
@@ -256,6 +266,7 @@ class WanEmulator:
         self.profile = profile
         self._seed = seed
         self.now = 0.0  # the virtual clock (seconds)
+        self._lock = new_lock()
         self._links: Dict[Tuple[str, str], LinkModel] = {}
         self._regions: Dict[str, str] = {}
         self._stragglers: Dict[str, Optional[_Straggler]] = {}
@@ -264,23 +275,33 @@ class WanEmulator:
 
     # -- topology ------------------------------------------------------
 
-    def register(self, node_id: str) -> None:
-        """Assign ``node_id`` a region, round-robin in registration
-        order (ChannelNetwork.join order — sorted ids for every
-        driver in the tree, so the mapping is schedule-stable)."""
+    def _register_locked(self, node_id: str) -> None:
         if node_id not in self._regions:
             regions = self.profile.regions
             self._regions[node_id] = regions[len(self._regions) % len(regions)]
 
-    def region_of(self, node_id: str) -> str:
-        self.register(node_id)
+    def register(self, node_id: str) -> None:
+        """Assign ``node_id`` a region, round-robin in registration
+        order (ChannelNetwork.join order — sorted ids for every
+        driver in the tree, so the mapping is schedule-stable)."""
+        with self._lock:
+            self._register_locked(node_id)
+
+    def _region_of_locked(self, node_id: str) -> str:
+        self._register_locked(node_id)
         return self._regions[node_id]
 
-    def _link(self, sender: str, receiver: str) -> LinkModel:
+    def region_of(self, node_id: str) -> str:
+        with self._lock:
+            return self._region_of_locked(node_id)
+
+    def _link_locked(self, sender: str, receiver: str) -> LinkModel:
         key = (sender, receiver)
         link = self._links.get(key)
         if link is None:
-            same = self.region_of(sender) == self.region_of(receiver)
+            same = self._region_of_locked(
+                sender
+            ) == self._region_of_locked(receiver)
             link = LinkModel(
                 self.profile,
                 same,
@@ -289,7 +310,7 @@ class WanEmulator:
             self._links[key] = link
         return link
 
-    def _straggler(self, node_id: str) -> Optional[_Straggler]:
+    def _straggler_locked(self, node_id: str) -> Optional[_Straggler]:
         if node_id not in self._stragglers:
             p = self.profile
             rng = wan_rng(self._seed, "straggler", node_id)
@@ -306,31 +327,37 @@ class WanEmulator:
         """Price one frame: the virtual time at which it becomes
         visible to the delivery scheduler."""
         p = self.profile
-        link = self._link(sender, receiver)
         now = self.now
-        owd = (link.rtt_s / 2.0) * (1.0 + p.jitter_frac * link.rng.random())
-        if p.loss_p > 0.0:
-            # reliable-transport retransmission: every seeded loss
-            # adds one RTO, doubling (TCP-ish) up to the cap
-            rto = max(2.0 * link.rtt_s, 0.01)
-            lost = 0
-            while lost < _MAX_RETRANSMITS and link.rng.random() < p.loss_p:
-                owd += rto
-                rto *= 2.0
-                lost += 1
-            self.retransmits += lost
-        start = now
-        if p.bandwidth_bps:
-            # frames sharing a link serialize behind its send horizon
-            start = max(now, link.busy_until) + nbytes / p.bandwidth_bps
-            link.busy_until = start
-        mult = 1.0
-        s = self._straggler(sender)
-        if s is not None:
-            mult = s.multiplier(now)
-        r = self._straggler(receiver)
-        if r is not None:
-            mult = max(mult, r.multiplier(now))
+        with self._lock:
+            link = self._link_locked(sender, receiver)
+            owd = (link.rtt_s / 2.0) * (
+                1.0 + p.jitter_frac * link.rng.random()
+            )
+            if p.loss_p > 0.0:
+                # reliable-transport retransmission: every seeded loss
+                # adds one RTO, doubling (TCP-ish) up to the cap
+                rto = max(2.0 * link.rtt_s, 0.01)
+                lost = 0
+                while (
+                    lost < _MAX_RETRANSMITS
+                    and link.rng.random() < p.loss_p
+                ):
+                    owd += rto
+                    rto *= 2.0
+                    lost += 1
+                self.retransmits += lost
+            start = now
+            if p.bandwidth_bps:
+                # frames sharing a link serialize behind its horizon
+                start = max(now, link.busy_until) + nbytes / p.bandwidth_bps
+                link.busy_until = start
+            mult = 1.0
+            s = self._straggler_locked(sender)
+            if s is not None:
+                mult = s.multiplier(now)
+            r = self._straggler_locked(receiver)
+            if r is not None:
+                mult = max(mult, r.multiplier(now))
         ready = start + owd * mult
         if ready > now:
             self.frames_delayed += 1
@@ -347,13 +374,14 @@ class WanEmulator:
         """One link's model state for ``ChannelNetwork.link_states``:
         base rtt_ms, the profile loss probability, and whether either
         endpoint is inside a straggler episode right now."""
-        link = self._link(sender, receiver)
-        straggling = False
-        for node in (sender, receiver):
-            s = self._straggler(node)
-            if s is not None and s.active(self.now):
-                straggling = True
-                break
+        with self._lock:
+            link = self._link_locked(sender, receiver)
+            straggling = False
+            for node in (sender, receiver):
+                s = self._straggler_locked(node)
+                if s is not None and s.active(self.now):
+                    straggling = True
+                    break
         return {
             "rtt_ms": link.rtt_s * 1e3,
             "loss": self.profile.loss_p,
@@ -368,9 +396,12 @@ class WanEmulator:
 
     def stats(self) -> Dict[str, object]:
         """The ``Metrics.snapshot()["wan"]`` provider payload."""
-        episodes = sum(
-            s.episodes for s in self._stragglers.values() if s is not None
-        )
+        with self._lock:
+            episodes = sum(
+                s.episodes
+                for s in self._stragglers.values()
+                if s is not None
+            )
         return {
             "enabled": 1,
             "profile": self.profile.name,

@@ -13,12 +13,16 @@ or ledger bytes) and OS entropy / concurrency hazards:
   must call this instead of touching ``random`` directly — the
   staticcheck DET001 rule enforces exactly that.
 - ``guarded_by`` declares which instance attributes a class's lock
-  protects.  It is deliberately a *declaration*, not a runtime wrapper
+  protects.  By default it is a *declaration*, not a runtime wrapper
   (no per-access overhead on hot paths): the metadata lands on the
   class as ``__guarded_by__`` for tests/tooling, and the staticcheck
-  CONC001 rule statically requires every access to sit inside
+  CONC001/CONC003 rules statically require every access to sit inside
   ``with self.<lock>:`` (methods named ``*_locked`` assert the caller
-  already holds it).
+  already holds it).  With ``CLEISTHENES_LOCKCHECK=1`` the SAME
+  registry arms the runtime sanitizer (utils/lockcheck.py): the
+  decorator installs per-access lock assertions, so the contract is
+  either statically proven or dynamically watched — never merely
+  commented.
 
 utils/ sits OUTSIDE the determinism plane precisely so this module can
 legally touch ``random.SystemRandom`` — one audited site instead of N
@@ -29,6 +33,8 @@ from __future__ import annotations
 
 import random
 from typing import Dict, Optional
+
+from cleisthenes_tpu.utils import lockcheck
 
 
 def proposal_rng(seed: Optional[int], node_id: str) -> random.Random:
@@ -74,8 +80,11 @@ def guarded_by(lock_attr: str, *attrs: str):
 
     Stacks/merges across multiple decorators (a class may hold several
     locks).  The declaration is enforced statically by staticcheck's
-    CONC001 rule; at runtime it only records ``cls.__guarded_by__ =
-    {attr: lock_attr}`` so tests can assert coverage.
+    CONC001/CONC003 rules; at runtime it records
+    ``cls.__guarded_by__ = {attr: lock_attr}`` so tests can assert
+    coverage — and, when the lock sanitizer is armed
+    (``CLEISTHENES_LOCKCHECK=1``), installs per-access held-lock
+    assertions over exactly that registry (utils/lockcheck.py).
     """
     if not attrs:
         raise ValueError("guarded_by needs at least one attribute name")
@@ -85,6 +94,8 @@ def guarded_by(lock_attr: str, *attrs: str):
         for a in attrs:
             merged[a] = lock_attr
         cls.__guarded_by__ = merged
+        if lockcheck.is_enabled():
+            lockcheck.install(cls)
         return cls
 
     return deco

@@ -12,11 +12,11 @@ from __future__ import annotations
 
 import bisect
 import collections
-import threading
 import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from cleisthenes_tpu.utils.determinism import guarded_by
+from cleisthenes_tpu.utils.lockcheck import new_lock
 
 
 @guarded_by("_lock", "_v")
@@ -25,7 +25,7 @@ class Counter:
 
     def __init__(self) -> None:
         self._v = 0
-        self._lock = threading.Lock()
+        self._lock = new_lock()
 
     def inc(self, by: int = 1) -> None:
         with self._lock:
@@ -74,7 +74,7 @@ class Histogram:
         self._bucket_counts: List[int] = [0] * len(self.bucket_bounds)
         self._total_sum = 0.0
         self._total_count = 0
-        self._lock = threading.Lock()
+        self._lock = new_lock()
 
     def observe(self, v: float) -> None:
         with self._lock:
@@ -248,7 +248,7 @@ class Metrics:
         # watchdog's stall detector measures "time since progress"
         # against this (never-committed reads as age since boot)
         self._last_commit_t: Optional[float] = None
-        self._lock = threading.Lock()
+        self._lock = new_lock()
         # transport-health provider (transport.health.PeerHealthTracker
         # .snapshot, set by the host that owns the dial layer): folds a
         # per-peer UP/DEGRADED/DOWN block into snapshot()

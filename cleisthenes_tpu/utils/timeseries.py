@@ -35,6 +35,7 @@ import time
 from typing import Callable, Deque, Dict, List, Optional, Tuple
 
 from cleisthenes_tpu.utils.determinism import guarded_by
+from cleisthenes_tpu.utils.lockcheck import new_lock
 
 DEFAULT_CAP = 512
 
@@ -87,7 +88,7 @@ class TimeSeriesSampler:
         self.cap = cap
         self._series: Dict[str, Deque[Point]] = {}
         self._samples = 0
-        self._lock = threading.Lock()
+        self._lock = new_lock()
         self._on_tick: List[Callable[[], None]] = []
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None

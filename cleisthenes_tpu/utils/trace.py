@@ -45,11 +45,11 @@ from __future__ import annotations
 
 import collections
 import contextlib
-import threading
 import time
 from typing import Deque, Dict, Iterable, List, Optional, Tuple
 
 from cleisthenes_tpu.utils.determinism import guarded_by
+from cleisthenes_tpu.utils.lockcheck import new_lock
 
 # The stage vocabulary: every event belongs to exactly one plane, and
 # the critical-path report attributes epoch wall time to these names.
@@ -105,7 +105,7 @@ class TraceRecorder:
         self._seq = 0
         self._dropped = 0
         self._high_water = 0
-        self._lock = threading.Lock()
+        self._lock = new_lock()
 
     @staticmethod
     def now() -> float:

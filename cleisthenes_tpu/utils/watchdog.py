@@ -41,11 +41,11 @@ detectors without sleeping.
 
 from __future__ import annotations
 
-import threading
 import time
 from typing import Callable, Dict, List, Optional
 
 from cleisthenes_tpu.utils.determinism import guarded_by
+from cleisthenes_tpu.utils.lockcheck import new_lock
 from cleisthenes_tpu.utils.metrics import Metrics
 
 UP = "up"
@@ -133,7 +133,7 @@ class SloWatchdog:
                 SETTLE_STALL,
             )
         }
-        self._lock = threading.Lock()
+        self._lock = new_lock()
 
     # -- detectors ---------------------------------------------------------
 

@@ -1,0 +1,36 @@
+"""Known-bad CONC003 corpus: *_locked callees invoked without the
+caller lexically holding the callee class's declared lock — the
+interprocedural gap CONC001 (same-method discipline) cannot see."""
+
+import threading
+
+from cleisthenes_tpu.utils.determinism import guarded_by
+
+
+@guarded_by("_lock", "_items")
+class Store:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = {}
+
+    def _size_locked(self):
+        return len(self._items)
+
+    def snapshot(self):
+        # same-class caller, lock not held at the call site
+        return self._size_locked()  # BAD:CONC003
+
+    def drain(self):
+        with self._lock:
+            n = self._size_locked()
+        # ...and held-then-released does not count: the with block
+        # closed before this call
+        return n + self._size_locked()  # BAD:CONC003
+
+
+class Reader:
+    def report(self):
+        store = Store()
+        # cross-class caller through a constructor-typed local,
+        # holding NO lock at all
+        return store._size_locked()  # BAD:CONC003

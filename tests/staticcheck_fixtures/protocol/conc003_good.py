@@ -1,0 +1,35 @@
+"""Known-good CONC003 corpus: every *_locked call site holds the
+callee's declared lock, defers to its own *_locked caller, or runs in
+single-threaded construction."""
+
+import threading
+
+from cleisthenes_tpu.utils.determinism import guarded_by
+
+
+@guarded_by("_lock", "_items")
+class Store:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = {}
+        # constructors are exempt: nothing else can hold a reference
+        self._warm_locked()
+
+    def _size_locked(self):
+        return len(self._items)
+
+    def _warm_locked(self):
+        # *_locked calling a sibling *_locked of the same class
+        # defers the obligation to ITS callers (transitivity)
+        return self._size_locked()
+
+    def snapshot(self):
+        with self._lock:
+            return self._size_locked()
+
+
+class Reader:
+    def report(self):
+        store = Store()
+        with store._lock:
+            return store._size_locked()

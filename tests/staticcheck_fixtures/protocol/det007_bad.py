@@ -1,0 +1,23 @@
+"""Known-bad DET007 corpus: entropy escaping through a returning
+helper into determinism-plane state — DET001 convicts the source
+line, DET007 convicts where the derived value LANDS."""
+
+import time
+
+
+class EpochState:
+    def _stamp(self):
+        return time.time()  # BAD:DET001
+
+    def mark(self):
+        # the store is one hop from the source: only the taint walk
+        # connects them
+        self.t_start = self._stamp()  # BAD:DET007
+
+    def reseed(self):
+        salt = self._stamp()
+        # tainted argument into a plane function
+        self._apply(salt)  # BAD:DET007
+
+    def _apply(self, salt):
+        self.salt = salt

@@ -1,0 +1,31 @@
+"""Known-good DET007 corpus: plane state fed from seeded inputs, the
+sanctioned utils.determinism doorway, or pragma-owned exceptions."""
+
+from cleisthenes_tpu.utils.determinism import proposal_rng
+
+
+class EpochState:
+    def __init__(self, seed, node_id):
+        # the sanctioned doorway: utils.determinism defs never count
+        # as entropy sources (that module owns the seed->entropy fork)
+        self._rng = proposal_rng(seed, node_id)
+
+    def _derive(self, seed):
+        return seed * 2654435761 % (1 << 32)
+
+    def mark(self, seed):
+        # a pure function of the seed is not entropy
+        self.t_start = self._derive(seed)
+
+    def pick(self, n):
+        self.last = self._rng.randrange(n)
+
+
+class Telemetry:
+    def stamp(self):
+        import time
+
+        # a pragma-owned exception seeds no taint: the justified
+        # allow already records why this wall-clock read is legal
+        t = time.time()  # staticcheck: allow[DET001] obs-only stamp
+        self.t_obs = t

@@ -1,0 +1,38 @@
+"""Known-bad CONC004 corpus: blocking calls one or more hops BELOW a
+dispatcher handler — invisible to CONC002's single-body scan, caught
+by the pass-3 reachability walk."""
+
+import os
+import time
+
+
+class Conn:
+    def __init__(self, fd):
+        self._fd = fd
+        self.outbox = []
+
+    def handle_frame(self, frame):
+        self.outbox.append(frame)
+        self._persist()
+
+    def _persist(self):
+        os.fsync(self._fd)  # BAD:CONC004
+
+    def on_tick(self):
+        self._drain_slowly()
+
+    def _drain_slowly(self):
+        while self.outbox:
+            self.outbox.pop(0)
+            time.sleep(0.01)  # BAD:CONC004
+
+    def serve_batch(self, frames):
+        for frame in frames:
+            self._relay(frame)
+
+    def _relay(self, frame):
+        self._deep_relay(frame)
+
+    def _deep_relay(self, frame):
+        # two hops down still stalls the dispatch thread
+        return self._sock.recv(1024)  # BAD:CONC004

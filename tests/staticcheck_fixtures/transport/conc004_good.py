@@ -1,0 +1,28 @@
+"""Known-good CONC004 corpus: handlers enqueue; the blocking helpers
+are reachable only from dedicated worker loops (non-handler names),
+which MAY block."""
+
+import os
+import time
+
+
+class Conn:
+    def __init__(self, fd):
+        self._fd = fd
+        self.outbox = []
+
+    def handle_frame(self, frame):
+        self.outbox.append(frame)
+
+    def on_tick(self):
+        return len(self.outbox)
+
+    def writer_loop(self):
+        # not a handler: the dedicated writer thread owns the fsync
+        while self.outbox:
+            self.outbox.pop(0)
+            self._persist()
+            time.sleep(0.01)
+
+    def _persist(self):
+        os.fsync(self._fd)

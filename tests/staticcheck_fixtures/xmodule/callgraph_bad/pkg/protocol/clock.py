@@ -1,0 +1,5 @@
+import time
+
+
+def wall():
+    return time.time()  # BAD:DET001

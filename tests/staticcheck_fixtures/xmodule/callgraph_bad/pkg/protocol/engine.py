@@ -1,0 +1,15 @@
+from pkg.protocol import clock
+from pkg.protocol.state import Table
+
+
+class Engine:
+    def lookup(self, k):
+        t = Table()
+        # the guarded class lives one module away: only the
+        # cross-file graph can demand its lock here
+        return t._get_locked(k)  # BAD:CONC003
+
+    def mark(self):
+        # the entropy source is two files away (clock.wall ->
+        # time.time); the derived value still lands in plane state
+        self.t0 = clock.wall()  # BAD:DET007

@@ -1,0 +1,11 @@
+from pkg.transport import helpers
+
+
+class Conn:
+    def __init__(self, fd):
+        self._fd = fd
+
+    def handle_frame(self, frame):
+        # the blocking call lives in another module: CONC002's
+        # single-body scan sees a clean handler
+        helpers.slow_write(self._fd)

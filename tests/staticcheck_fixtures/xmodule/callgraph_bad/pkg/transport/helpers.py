@@ -1,0 +1,5 @@
+import os
+
+
+def slow_write(fd):
+    os.fsync(fd)  # BAD:CONC004

@@ -1,0 +1,2 @@
+def logical(seed):
+    return seed + 1

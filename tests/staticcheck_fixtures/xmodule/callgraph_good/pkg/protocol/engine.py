@@ -1,0 +1,12 @@
+from pkg.protocol import clock
+from pkg.protocol.state import Table
+
+
+class Engine:
+    def lookup(self, k):
+        t = Table()
+        with t._lock:
+            return t._get_locked(k)
+
+    def mark(self, seed):
+        self.t0 = clock.logical(seed)

@@ -1,0 +1,13 @@
+import threading
+
+from cleisthenes_tpu.utils.determinism import guarded_by
+
+
+@guarded_by("_lock", "_table")
+class Table:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._table = {}
+
+    def _get_locked(self, k):
+        return self._table.get(k)

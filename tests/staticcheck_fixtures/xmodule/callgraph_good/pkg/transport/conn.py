@@ -1,0 +1,16 @@
+from pkg.transport import helpers
+
+
+class Conn:
+    def __init__(self, fd):
+        self._fd = fd
+        self.outbox = []
+
+    def handle_frame(self, frame):
+        self.outbox.append(frame)
+
+    def writer_loop(self):
+        # not a handler: the dedicated writer thread owns the fsync
+        while self.outbox:
+            self.outbox.pop(0)
+            helpers.slow_write(self._fd)

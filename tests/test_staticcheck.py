@@ -106,6 +106,19 @@ BAD_FIXTURES = [
     "transport/verify001_bad.py",
     "protocol/conc001_bad.py",
     "transport/conc002_bad.py",
+    # the caller-holds-lock contract (ISSUE 17): *_locked callees
+    # invoked without the callee class's declared lock — the
+    # interprocedural gap CONC001's same-method scan cannot see
+    "protocol/conc003_bad.py",
+    # blocking calls one or more hops BELOW a handler (ISSUE 17):
+    # CONC002 sees a clean handler body; the pass-3 reachability
+    # walk convicts the helper's fsync/sleep/recv
+    "transport/conc004_bad.py",
+    # interprocedural entropy taint (ISSUE 17): DET001 convicts the
+    # source line, DET007 convicts where the derived value LANDS in
+    # plane state — one hop apart within a file here, cross-module
+    # in the xmodule/callgraph_bad tree
+    "protocol/det007_bad.py",
     "protocol/err001_bad.py",
     # the WAN stem rule (ISSUE 16): transport files named wan/wan_*
     # join the determinism plane, so raw random/wall-clock in a link
@@ -126,6 +139,9 @@ GOOD_FIXTURES = [
     "transport/verify001_good.py",
     "protocol/conc001_good.py",
     "transport/conc002_good.py",
+    "protocol/conc003_good.py",
+    "transport/conc004_good.py",
+    "protocol/det007_good.py",
     "protocol/err001_good.py",
     "transport/wan_det001_good.py",
     "protocol/pragma_file_cases.py",
@@ -228,8 +244,11 @@ def test_rule_catalog_registered():
         "DET004",
         "DET005",
         "DET006",
+        "DET007",
         "CONC001",
         "CONC002",
+        "CONC003",
+        "CONC004",
         "ERR001",
         "WIRE001",
         "SCHEMA001",

@@ -58,6 +58,52 @@ def test_xmodule_good_tree_is_clean():
     assert _findings(XMODULE / "good") == set()
 
 
+def test_callgraph_bad_tree_exact_cross_module_findings():
+    """Pass 3 (ISSUE 17): each conviction needs a call edge into
+    ANOTHER file — the guarded class, the blocking helper and the
+    entropy source all live one module away from the code that
+    misuses them."""
+    assert _findings(XMODULE / "callgraph_bad") == {
+        # clock.wall's direct wall-clock read (per-file DET001)...
+        ("DET001", "pkg/protocol/clock.py", 5),
+        # ...and where its return value LANDS two files away
+        ("DET007", "pkg/protocol/engine.py", 15),
+        # engine calls state.Table._get_locked() holding no lock
+        ("CONC003", "pkg/protocol/engine.py", 10),
+        # conn.handle_frame reaches helpers.slow_write's fsync;
+        # the finding sits at the BLOCKING line, not the handler
+        ("CONC004", "pkg/transport/helpers.py", 5),
+    }
+
+
+def test_callgraph_good_tree_is_clean():
+    assert _findings(XMODULE / "callgraph_good") == set()
+
+
+def test_callgraph_findings_carry_their_evidence_chain():
+    """CONC004's related tuple is the hop-by-hop call path from the
+    handler entry down to the blocking call — the debuggability
+    contract the SARIF relatedLocations ride on."""
+    root = XMODULE / "callgraph_bad"
+    found, _n = check_paths([root], root)
+    by_rule = {f.rule: f for f in found}
+    chain = by_rule["CONC004"].related
+    assert [(p, ln) for p, ln, _note in chain] == [
+        ("pkg/transport/conn.py", 11),
+        ("pkg/transport/helpers.py", 4),
+    ]
+    assert "handle_frame" in chain[0][2]
+    # CONC003/DET007 point back at the defining/origin site
+    assert by_rule["CONC003"].related[0][:2] == (
+        "pkg/protocol/state.py",
+        12,
+    )
+    assert by_rule["DET007"].related[0][:2] == (
+        "pkg/protocol/clock.py",
+        4,
+    )
+
+
 def test_xmodule_good_breaks_when_fingerprint_key_removed(tmp_path):
     """The index really reads the OTHER file: deleting the good
     tree's fingerprint key manufactures the ARM001 finding."""
@@ -242,6 +288,36 @@ def test_sarif_output_is_annotatable():
         ("WIRE001", rel, 9),
         ("WIRE001", rel, 10),
     }
+
+
+def test_sarif_carries_related_locations_for_call_chains():
+    """A pass-3 finding's SARIF result embeds the full call chain as
+    relatedLocations, so the report alone shows WHY the sink is
+    reachable."""
+    proc = _run_cli(
+        "tests/staticcheck_fixtures/transport/conc004_bad.py",
+        "--format",
+        "sarif",
+        "--no-baseline",
+    )
+    assert proc.returncode == 1
+    doc = json.loads(proc.stdout)
+    results = doc["runs"][0]["results"]
+    conc004 = [r for r in results if r["ruleId"] == "CONC004"]
+    assert conc004
+    for r in conc004:
+        rels = r["relatedLocations"]
+        assert len(rels) >= 2  # >=1 hop + the containing function
+        for rel_loc in rels:
+            phys = rel_loc["physicalLocation"]
+            assert phys["artifactLocation"]["uriBaseId"] == "SRCROOT"
+            assert phys["region"]["startLine"] > 0
+            assert rel_loc["message"]["text"]
+    # the deepest chain walks serve_batch -> _relay -> _deep_relay
+    deepest = max(conc004, key=lambda r: len(r["relatedLocations"]))
+    notes = [x["message"]["text"] for x in deepest["relatedLocations"]]
+    assert "serve_batch" in notes[0] and "_relay" in notes[0]
+    assert "blocking call" in notes[-1]
 
 
 def test_whole_program_pass_under_wall_budget():

@@ -9,22 +9,31 @@ that keeps wall clocks, unseeded randomness, hash-order iteration,
 lock-discipline violations and swallowed exceptions out of the code
 paths where they can fork a ledger.
 
-Since ISSUE 14 the analyzer is a TWO-PASS whole-program tool: pass 1
-builds a cross-module symbol/registry index (payload kinds and pb
-extension tags, Metrics counters vs snapshot schema vs golden
-exposition, Config arm flags vs wave entry points vs perfgate
-fingerprint keys), pass 2 runs the per-file rules plus the registry
-rules (WIRE001/SCHEMA001/ARM001/VERIFY001) over it, and an audit mode
+Since ISSUE 14 the analyzer is a whole-program tool: pass 1 builds a
+cross-module symbol/registry index (payload kinds and pb extension
+tags, Metrics counters vs snapshot schema vs golden exposition,
+Config arm flags vs wave entry points vs perfgate fingerprint keys),
+pass 2 runs the per-file rules plus the registry rules
+(WIRE001/SCHEMA001/ARM001/VERIFY001) over it, and an audit mode
 machine-checks the pragma population (staleness + count budget).
+ISSUE 17 adds pass 3: a def->call graph over every scanned file and
+the interprocedural rules CONC003 (caller-holds lock discipline for
+*_locked functions), CONC004 (blocking calls transitively reachable
+from dispatcher callbacks) and DET007 (entropy taint flowing into
+determinism-plane state) — with the runtime lock sanitizer
+cleisthenes_tpu/utils/lockcheck.py as the dynamic twin over the same
+``@guarded_by`` registry.
 
 Layout:
   core.py           -- Finding/FileContext, pragma parsing + audit,
                        rule registry, baseline round-trip, the
-                       two-pass runner
+                       multi-pass runner
   rules.py          -- the per-file catalog (DET001-DET006, CONC001/
                        CONC002, ERR001)
   program.py        -- pass 1: the cross-module registry index
   registry_rules.py -- pass 2: WIRE001/SCHEMA001/ARM001 (+ VERIFY001)
+  callgraph.py      -- pass 3: the call graph + CONC003/CONC004/
+                       DET007 (interprocedural rules)
   __main__          -- CLI: ``python -m tools.staticcheck
                        cleisthenes_tpu tools tests --audit-pragmas``
 
@@ -45,6 +54,7 @@ from tools.staticcheck.core import (
 )
 import tools.staticcheck.rules  # noqa: F401  (registers the catalog)
 import tools.staticcheck.registry_rules  # noqa: F401  (registry rules)
+import tools.staticcheck.callgraph  # noqa: F401  (pass-3 call-graph rules)
 
 __all__ = [
     "BASELINE_PATH",

@@ -85,6 +85,24 @@ def to_sarif(findings, baselined) -> dict:
                 }
             ],
         }
+        if f.related:
+            # pass-3 findings (CONC003/CONC004/DET007) carry their
+            # evidence chain — each hop of the call path or taint flow
+            # becomes one relatedLocation, so the report alone shows
+            # WHY the sink is reachable
+            out["relatedLocations"] = [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {
+                            "uri": rpath,
+                            "uriBaseId": "SRCROOT",
+                        },
+                        "region": {"startLine": rline},
+                    },
+                    "message": {"text": rnote},
+                }
+                for rpath, rline, rnote in f.related
+            ]
         if suppressed:
             out["suppressions"] = [
                 {"kind": "external", "justification": "baselined"}

@@ -1,0 +1,1113 @@
+"""Pass 3 of the whole-program analyzer: the call graph and the
+interprocedural rule catalog.
+
+Passes 1-2 (program.py / registry_rules.py) check cross-module
+REGISTRY contracts; everything concurrency- and entropy-shaped was
+still judged one function at a time.  This pass builds a def->call
+graph over every scanned file and runs three rules across it:
+
+- CONC003  caller-holds discipline: a call site of a ``*_locked``
+           function must lexically hold the callee class's
+           ``@guarded_by`` lock — unless the caller is itself a
+           ``*_locked`` method of the same class (then ITS call sites
+           are checked, walking the contract transitively) or a
+           constructor.  Replaces CONC001's single-file approximation
+           of the caller side.
+- CONC004  blocking-call reachability: a blocking call (time.sleep,
+           socket/select waits, os.fsync, subprocess) transitively
+           reachable from a dispatcher handler callback (handle_*/
+           on_*/serve_*, incl. on_idle and serve_wave) stalls every
+           instance behind the dispatch thread.  Makes CONC002
+           transitive; depth-0 sites CONC002 already reports are not
+           re-reported.
+- DET007   interprocedural entropy taint: a value produced by a
+           non-``utils.determinism`` randomness/wall-clock source —
+           directly or through any chain of returning functions —
+           must not be stored into protocol-plane instance state or
+           passed into a protocol-plane function.  Subsumes DET001's
+           recall gap (a plane file laundering entropy through a
+           helper module).
+
+Call resolution (documented soundness gaps and all):
+
+1. ``self.m()``     -> the enclosing class's own ``m`` when defined
+                       there (same file).
+2. ``mod.f()``      -> through import aliases (FileContext.resolve)
+                       and a dotted-module-suffix -> scanned-file map
+                       (relative imports resolve by longest unique
+                       suffix).
+3. ``bare()``       -> a from-imported function (via 2) or any
+                       scanned def of that name.
+4. ``obj.m()``      -> when ``obj`` is a local or ``self`` attribute
+                       assigned ``ClassName(...)`` in the scanned
+                       tree, the method of that class.
+5. fallback         -> name match across every scanned def, EXCLUDING
+                       builtin-collection method names (append/get/
+                       pop/items/...) and dunders.  CONC004 follows
+                       every candidate (recall); DET007 propagates
+                       only through UNIQUE matches (precision).
+
+Known gaps: inheritance is not walked (a method resolved on a base
+class only lands via name match); values returned through containers
+lose taint; callables passed as arguments create no edge.  The
+runtime twin — cleisthenes_tpu/utils/lockcheck.py, sharing the same
+``@guarded_by`` registry — watches what the graph cannot prove.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import pathlib
+from typing import (
+    Dict,
+    FrozenSet,
+    Iterator,
+    List,
+    Optional,
+    Set,
+    Tuple,
+)
+
+from tools.staticcheck.core import (
+    FileContext,
+    Finding,
+    parse_pragmas,
+    rule,
+)
+from tools.staticcheck.rules import (
+    _BLOCKING_METHOD_NAMES,
+    _DET001_EXACT,
+    _DET001_MODULES,
+    _guarded_decls,
+    _is_handler_name,
+)
+
+# Names that are overwhelmingly builtin-collection methods: a name
+# match on these would wire every list.append in the tree to every
+# class's append method.  Excluded from fallback resolution (gap:
+# a genuinely project-defined method with one of these names only
+# resolves through typing or self).
+_COLLECTION_METHODS = frozenset(
+    (
+        "append",
+        "add",
+        "extend",
+        "pop",
+        "get",
+        "items",
+        "keys",
+        "values",
+        "clear",
+        "update",
+        "insert",
+        "remove",
+        "discard",
+        "put",
+        "setdefault",
+        "popleft",
+        "appendleft",
+        "sort",
+        "index",
+        "count",
+        "copy",
+        "join",
+        "split",
+        "strip",
+        "encode",
+        "decode",
+        "read",
+        "write",
+        "close",
+        "format",
+        "flush",
+        "release",
+        "acquire",
+        "set",
+        "wait",
+        "start",
+    )
+)
+
+# dotted blocking calls; CONC002's vocabulary plus the durability /
+# process-spawn calls only reachability analysis can police
+_BLOCKING_EXACT = frozenset(("time.sleep", "select.select", "os.fsync"))
+_BLOCKING_MODULE_PREFIXES = ("socket.", "subprocess.")
+_CONC002_EXACT = frozenset(("time.sleep", "select.select"))
+
+_CONSTRUCTOR_EXEMPT = frozenset(("__init__", "__del__"))
+
+
+def _dotted_expr(node: ast.AST) -> Optional[str]:
+    """Render a Name/Attribute chain ("self._lock", "x.fh"); None for
+    anything dynamic (subscripts, calls)."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _dotted_expr(node.value)
+        if base is not None:
+            return f"{base}.{node.attr}"
+    return None
+
+
+@dataclasses.dataclass
+class CallSite:
+    """One call expression inside a function body."""
+
+    name: str  # bare callable name (attr or id)
+    recv: Optional[str]  # rendered receiver ("self", "self.wal", "x")
+    dotted: Optional[str]  # import-alias resolution of the callee
+    line: int
+    col: int
+    held: FrozenSet[str]  # "with <expr>:" exprs lexically held here
+    node: ast.Call
+
+
+@dataclasses.dataclass
+class BlockingSite:
+    what: str  # human name of the blocking call
+    line: int
+    col: int
+    conc002_vocab: bool  # CONC002 would report this at depth 0
+
+
+@dataclasses.dataclass
+class FuncNode:
+    """One function/method definition: a call-graph node."""
+
+    relpath: str
+    qual: str  # "Class.method" / "func" / "outer.inner"
+    name: str  # last component
+    cls: Optional[str]  # enclosing class name, if a method
+    line: int
+    fn: ast.AST
+    calls: List[CallSite]
+    blocking: List[BlockingSite]
+    local_types: Dict[str, str]  # local var -> class name (x = C())
+    in_plane: bool
+    in_transport: bool
+
+    @property
+    def key(self) -> Tuple[str, str]:
+        return (self.relpath, self.qual)
+
+
+@dataclasses.dataclass
+class CallGraph:
+    """Every node plus the side tables resolution needs."""
+
+    nodes: Dict[Tuple[str, str], FuncNode]
+    by_name: Dict[str, List[Tuple[str, str]]]  # bare name -> keys
+    classes: Dict[str, List[Tuple[str, ast.ClassDef]]]  # name -> defs
+    guarded: Dict[Tuple[str, str], Dict[str, str]]  # (file, cls) decl
+    methods: Dict[Tuple[str, str], Dict[str, Tuple[str, str]]]
+    attr_types: Dict[Tuple[str, str], Dict[str, str]]  # self.X = C()
+    module_files: Dict[str, List[str]]  # dotted-suffix -> relpaths
+
+    def resolve_module(self, dotted_mod: str) -> Optional[str]:
+        hits = self.module_files.get(dotted_mod)
+        if hits is not None and len(hits) == 1:
+            return hits[0]
+        return None
+
+    def class_of(self, name: str) -> Optional[Tuple[str, ast.ClassDef]]:
+        hits = self.classes.get(name)
+        if hits is not None and len(hits) == 1:
+            return hits[0]
+        return None
+
+
+def _class_name_of_ctor(call: ast.Call) -> Optional[str]:
+    fn = call.func
+    name = None
+    if isinstance(fn, ast.Name):
+        name = fn.id
+    elif isinstance(fn, ast.Attribute):
+        name = fn.attr
+    if name and name[:1].isupper():
+        return name
+    return None
+
+
+class _FileExtractor(ast.NodeVisitor):
+    """One pass over a file: nodes, class decls, attribute typing."""
+
+    def __init__(self, ctx: FileContext, graph: "CallGraph") -> None:
+        self.ctx = ctx
+        self.graph = graph
+        self._cls_stack: List[str] = []
+        self._qual_stack: List[str] = []
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self.graph.classes.setdefault(node.name, []).append(
+            (self.ctx.relpath, node)
+        )
+        decls = _guarded_decls(node)
+        if decls:
+            self.graph.guarded[(self.ctx.relpath, node.name)] = decls
+        self._cls_stack.append(node.name)
+        self._qual_stack.append(node.name)
+        self.generic_visit(node)
+        self._qual_stack.pop()
+        self._cls_stack.pop()
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._function(node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._function(node)
+
+    def _function(self, node: ast.AST) -> None:
+        qual = ".".join(self._qual_stack + [node.name])
+        cls = self._cls_stack[-1] if self._cls_stack else None
+        fnode = FuncNode(
+            relpath=self.ctx.relpath,
+            qual=qual,
+            name=node.name,
+            cls=cls,
+            line=node.lineno,
+            fn=node,
+            calls=[],
+            blocking=[],
+            local_types={},
+            in_plane=self.ctx.in_plane,
+            in_transport=self.ctx.in_transport,
+        )
+        self.graph.nodes[fnode.key] = fnode
+        self.graph.by_name.setdefault(node.name, []).append(fnode.key)
+        if cls is not None and len(self._qual_stack) == 1:
+            self.graph.methods.setdefault(
+                (self.ctx.relpath, cls), {}
+            )[node.name] = fnode.key
+        _BodyWalker(self.ctx, self.graph, fnode).run()
+        # nested defs become their own nodes (with an implicit edge
+        # from the parent, added by _BodyWalker)
+        self._qual_stack.append(node.name)
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                self._function(child)
+            elif isinstance(child, ast.ClassDef):
+                self.visit_ClassDef(child)
+        self._qual_stack.pop()
+
+
+class _BodyWalker:
+    """Walks ONE function body (not nested defs): records call sites
+    with the lexically-held ``with`` set, blocking calls, and
+    local/attribute constructor typing."""
+
+    def __init__(
+        self, ctx: FileContext, graph: CallGraph, fnode: FuncNode
+    ) -> None:
+        self.ctx = ctx
+        self.graph = graph
+        self.fnode = fnode
+        self.held: List[str] = []
+
+    def run(self) -> None:
+        for stmt in self.fnode.fn.body:
+            self._visit(stmt)
+
+    def _note_ctor_types(self, node: ast.Assign) -> None:
+        if not isinstance(node.value, ast.Call):
+            return
+        cls_name = _class_name_of_ctor(node.value)
+        if cls_name is None or self.graph.class_of(cls_name) is None:
+            return
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name):
+                self.fnode.local_types[tgt.id] = cls_name
+            elif (
+                isinstance(tgt, ast.Attribute)
+                and isinstance(tgt.value, ast.Name)
+                and tgt.value.id == "self"
+                and self.fnode.cls is not None
+            ):
+                self.graph.attr_types.setdefault(
+                    (self.fnode.relpath, self.fnode.cls), {}
+                )[tgt.attr] = cls_name
+
+    def _record_call(self, node: ast.Call) -> None:
+        fn = node.func
+        if isinstance(fn, ast.Name):
+            name, recv = fn.id, None
+        elif isinstance(fn, ast.Attribute):
+            name, recv = fn.attr, _dotted_expr(fn.value)
+        else:
+            return
+        dotted = self.ctx.resolve(fn)
+        self.fnode.calls.append(
+            CallSite(
+                name=name,
+                recv=recv,
+                dotted=dotted,
+                line=node.lineno,
+                col=node.col_offset,
+                held=frozenset(self.held),
+                node=node,
+            )
+        )
+        if dotted is not None and (
+            dotted in _BLOCKING_EXACT
+            or dotted.startswith(_BLOCKING_MODULE_PREFIXES)
+        ):
+            self.fnode.blocking.append(
+                BlockingSite(
+                    what=dotted,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    conc002_vocab=(
+                        dotted in _CONC002_EXACT
+                        or dotted.startswith("socket.")
+                    ),
+                )
+            )
+        elif dotted is None and name in _BLOCKING_METHOD_NAMES:
+            self.fnode.blocking.append(
+                BlockingSite(
+                    what=f".{name}()",
+                    line=node.lineno,
+                    col=node.col_offset,
+                    conc002_vocab=True,
+                )
+            )
+
+    def _visit(self, node: ast.AST) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested def: implicit edge parent -> child (the parent
+            # at least defines it; most are called synchronously)
+            self.fnode.calls.append(
+                CallSite(
+                    name=node.name,
+                    recv=None,
+                    dotted=None,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    held=frozenset(self.held),
+                    node=ast.Call(
+                        func=ast.Name(id=node.name, ctx=ast.Load()),
+                        args=[],
+                        keywords=[],
+                    ),
+                )
+            )
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            acquired = []
+            for item in node.items:
+                expr = _dotted_expr(item.context_expr)
+                if expr is not None:
+                    acquired.append(expr)
+                    self.held.append(expr)
+            for child in node.body:
+                self._visit(child)
+            for _ in acquired:
+                self.held.pop()
+            return
+        if isinstance(node, ast.Assign):
+            self._note_ctor_types(node)
+        if isinstance(node, ast.Call):
+            self._record_call(node)
+        for child in ast.iter_child_nodes(node):
+            self._visit(child)
+
+
+def _module_dotted(relpath: str) -> List[str]:
+    """Every dotted suffix a relative/absolute import could spell for
+    this file: a/b/c.py -> [a.b.c, b.c, c]."""
+    parts = list(pathlib.PurePosixPath(relpath).with_suffix("").parts)
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return [".".join(parts[i:]) for i in range(len(parts))]
+
+
+def build_callgraph(ctx_map: Dict[str, FileContext]) -> CallGraph:
+    graph = CallGraph(
+        nodes={},
+        by_name={},
+        classes={},
+        guarded={},
+        methods={},
+        attr_types={},
+        module_files={},
+    )
+    for relpath in sorted(ctx_map):
+        for suffix in _module_dotted(relpath):
+            if suffix:
+                graph.module_files.setdefault(suffix, []).append(
+                    relpath
+                )
+    for relpath in sorted(ctx_map):
+        _FileExtractor(ctx_map[relpath], graph).visit(
+            ctx_map[relpath].tree
+        )
+    return graph
+
+
+# memoized per context-set: three rules (and the audit re-run) share
+# one graph build
+_GRAPH_CACHE: List[Tuple[Tuple[Tuple[str, int], ...], CallGraph]] = []
+
+
+def _graph_for(ctx_map: Dict[str, FileContext]) -> CallGraph:
+    key = tuple(
+        sorted((rp, id(ctx)) for rp, ctx in ctx_map.items())
+    )
+    for cached_key, cached in _GRAPH_CACHE:
+        if cached_key == key:
+            return cached
+    graph = build_callgraph(ctx_map)
+    del _GRAPH_CACHE[:]
+    _GRAPH_CACHE.append((key, graph))
+    return graph
+
+
+# ---------------------------------------------------------------------------
+# edge resolution
+# ---------------------------------------------------------------------------
+
+
+def _resolve_dotted(
+    graph: CallGraph, dotted: str
+) -> List[Tuple[str, str]]:
+    """mod.func / pkg.mod.Class.method through the module-suffix map."""
+    parts = dotted.split(".")
+    for i in range(len(parts) - 1, 0, -1):
+        relpath = graph.resolve_module(".".join(parts[:i]))
+        if relpath is None:
+            continue
+        rest = parts[i:]
+        if len(rest) == 1:
+            key = (relpath, rest[0])
+            if key in graph.nodes:
+                return [key]
+            # from mod import Class; Class.method would be rest==2
+        elif len(rest) == 2:
+            key = (relpath, ".".join(rest))
+            if key in graph.nodes:
+                return [key]
+        return []
+    return []
+
+
+def resolve_call(
+    graph: CallGraph, caller: FuncNode, site: CallSite
+) -> Tuple[List[Tuple[str, str]], bool]:
+    """(target node keys, exact) for one call site.  ``exact`` is True
+    for self-method / typed-receiver / import-resolved targets; False
+    for the name-match fallback (every scanned def of that name)."""
+    # 1. self.m() inside a class that defines m
+    if site.recv == "self" and caller.cls is not None:
+        m = graph.methods.get((caller.relpath, caller.cls), {})
+        key = m.get(site.name)
+        if key is not None:
+            return [key], True
+    # 2. import-alias dotted resolution
+    if site.dotted is not None:
+        keys = _resolve_dotted(graph, site.dotted)
+        if keys:
+            return keys, True
+    # 3. typed receiver: self.X = C(...) or x = C(...)
+    if site.recv is not None and site.recv != "self":
+        cls_name = None
+        if "." not in site.recv:
+            cls_name = caller.local_types.get(site.recv)
+        elif site.recv.startswith("self.") and caller.cls is not None:
+            attr = site.recv.split(".", 1)[1]
+            if "." not in attr:
+                cls_name = graph.attr_types.get(
+                    (caller.relpath, caller.cls), {}
+                ).get(attr)
+        if cls_name is not None:
+            hit = graph.class_of(cls_name)
+            if hit is not None:
+                key = graph.methods.get(
+                    (hit[0], cls_name), {}
+                ).get(site.name)
+                if key is not None:
+                    return [key], True
+    # 4. bare name -> a local def in the same file
+    if site.recv is None:
+        for cand in graph.by_name.get(site.name, ()):
+            if cand[0] == caller.relpath:
+                return [cand], True
+    # 5. name-match fallback
+    if (
+        site.name in _COLLECTION_METHODS
+        or site.name.startswith("__")
+    ):
+        return [], False
+    return list(graph.by_name.get(site.name, ())), False
+
+
+# ---------------------------------------------------------------------------
+# CONC003: caller-holds discipline for *_locked functions
+# ---------------------------------------------------------------------------
+
+
+def _required_locks(
+    graph: CallGraph, callee: FuncNode
+) -> List[str]:
+    """Locks a ``*_locked`` method's caller must hold: the locks
+    guarding the ``@guarded_by`` attrs it touches, else (if it only
+    delegates) every distinct declared lock of its class."""
+    if callee.cls is None:
+        return []
+    decls = graph.guarded.get((callee.relpath, callee.cls))
+    if not decls:
+        return []
+    touched: Set[str] = set()
+    for n in ast.walk(callee.fn):
+        if (
+            isinstance(n, ast.Attribute)
+            and isinstance(n.value, ast.Name)
+            and n.value.id == "self"
+            and n.attr in decls
+        ):
+            touched.add(decls[n.attr])
+    if touched:
+        return sorted(touched)
+    return sorted(set(decls.values()))
+
+
+@rule
+class Conc003CallerHoldsLock:
+    id = "CONC003"
+    doc = (
+        "every call site of a *_locked function must lexically hold "
+        "the callee class's @guarded_by lock (with <recv>.<lock>:), "
+        "unless the caller is itself a *_locked method of that class "
+        "(checked transitively at ITS call sites) or a constructor"
+    )
+
+    def check_program(
+        self, index, ctx_map: Dict[str, FileContext]
+    ) -> Iterator[Finding]:
+        graph = _graph_for(ctx_map)
+        for key in sorted(graph.nodes):
+            caller = graph.nodes[key]
+            if caller.name in _CONSTRUCTOR_EXEMPT:
+                continue
+            for site in caller.calls:
+                if not site.name.endswith("_locked"):
+                    continue
+                yield from self._check_site(
+                    graph, ctx_map, caller, site
+                )
+
+    def _check_site(
+        self,
+        graph: CallGraph,
+        ctx_map: Dict[str, FileContext],
+        caller: FuncNode,
+        site: CallSite,
+    ) -> Iterator[Finding]:
+        targets, _exact = resolve_call(graph, caller, site)
+        callees = [
+            graph.nodes[k]
+            for k in targets
+            if graph.nodes[k].cls is not None
+        ]
+        if not callees:
+            return
+        callee = callees[0]
+        # transitivity: a *_locked method calling a sibling *_locked
+        # method of the SAME class defers to its own callers
+        if (
+            caller.name.endswith("_locked")
+            and site.recv == "self"
+            and caller.cls == callee.cls
+            and caller.relpath == callee.relpath
+        ):
+            return
+        required = _required_locks(graph, callee)
+        if not required:
+            return
+        recv_base = site.recv if site.recv is not None else "self"
+        missing = [
+            lock
+            for lock in required
+            if f"{recv_base}.{lock}" not in site.held
+        ]
+        if not missing:
+            return
+        ctx = ctx_map.get(caller.relpath)
+        snippet = ctx.source_line(site.line) if ctx else ""
+        yield Finding(
+            rule=self.id,
+            path=caller.relpath,
+            line=site.line,
+            col=site.col,
+            message=(
+                f"{caller.qual}() calls {callee.cls}."
+                f"{site.name}() without holding "
+                f"`with {recv_base}.{missing[0]}:`; the *_locked "
+                "contract is caller-holds-lock (declared via "
+                f"@guarded_by on {callee.cls})"
+            ),
+            snippet=snippet,
+            related=(
+                (
+                    callee.relpath,
+                    callee.line,
+                    f"callee {callee.qual}() defined here "
+                    f"(requires {', '.join(required)})",
+                ),
+            ),
+        )
+
+
+# ---------------------------------------------------------------------------
+# CONC004: blocking calls reachable from dispatcher callbacks
+# ---------------------------------------------------------------------------
+
+
+@rule
+class Conc004BlockingReachability:
+    id = "CONC004"
+    doc = (
+        "no blocking call (time.sleep, socket/select waits, os.fsync, "
+        "subprocess) transitively reachable from a dispatcher handler "
+        "callback (handle_*/on_*/serve_*, incl. on_idle/serve_wave); "
+        "a blocked dispatch thread stalls every instance behind it"
+    )
+
+    def check_program(
+        self, index, ctx_map: Dict[str, FileContext]
+    ) -> Iterator[Finding]:
+        graph = _graph_for(ctx_map)
+        entries = [
+            key
+            for key in sorted(graph.nodes)
+            if _is_handler_name(graph.nodes[key].name)
+            and (
+                graph.nodes[key].in_plane
+                or graph.nodes[key].in_transport
+            )
+        ]
+        if not entries:
+            return
+        # BFS over the call graph from every entry at once; parent
+        # pointers reconstruct the shortest call chain per node
+        dist: Dict[Tuple[str, str], int] = {}
+        parent: Dict[
+            Tuple[str, str], Optional[Tuple[Tuple[str, str], CallSite]]
+        ] = {}
+        work: List[Tuple[str, str]] = []
+        for e in entries:
+            dist[e] = 0
+            parent[e] = None
+            work.append(e)
+        qi = 0
+        while qi < len(work):
+            key = work[qi]
+            qi += 1
+            node = graph.nodes[key]
+            for site in node.calls:
+                targets, _exact = resolve_call(graph, node, site)
+                for tkey in targets:
+                    if tkey in dist:
+                        continue
+                    dist[tkey] = dist[key] + 1
+                    parent[tkey] = (key, site)
+                    work.append(tkey)
+        seen_sites: Set[Tuple[str, int]] = set()
+        for key in sorted(dist, key=lambda k: (dist[k], k)):
+            node = graph.nodes[key]
+            if not (node.in_plane or node.in_transport):
+                continue
+            for b in node.blocking:
+                if dist[key] == 0 and b.conc002_vocab:
+                    continue  # CONC002's depth-0 report
+                site_id = (node.relpath, b.line)
+                if site_id in seen_sites:
+                    continue
+                seen_sites.add(site_id)
+                chain = self._chain(graph, parent, key)
+                entry = graph.nodes[chain[0][0]] if chain else node
+                ctx = ctx_map.get(node.relpath)
+                snippet = ctx.source_line(b.line) if ctx else ""
+                related = []
+                for hop_key, hop_site in chain:
+                    hop = graph.nodes[hop_key]
+                    related.append(
+                        (
+                            hop.relpath,
+                            hop_site.line,
+                            f"{hop.qual}() calls "
+                            f"{hop_site.name}() here",
+                        )
+                    )
+                related.append(
+                    (
+                        node.relpath,
+                        node.line,
+                        f"{node.qual}() contains the blocking call",
+                    )
+                )
+                yield Finding(
+                    rule=self.id,
+                    path=node.relpath,
+                    line=b.line,
+                    col=b.col,
+                    message=(
+                        f"blocking {b.what} is reachable from "
+                        f"dispatcher callback {entry.qual}() "
+                        f"({dist[key]} call(s) deep) and stalls the "
+                        "dispatch thread; move it off the handler "
+                        "path or defer it past the dispatch turn"
+                    ),
+                    snippet=snippet,
+                    related=tuple(related),
+                )
+
+    @staticmethod
+    def _chain(
+        graph: CallGraph,
+        parent: Dict,
+        key: Tuple[str, str],
+    ) -> List[Tuple[Tuple[str, str], CallSite]]:
+        """Call-site hops entry -> ... -> key, in call order."""
+        hops: List[Tuple[Tuple[str, str], CallSite]] = []
+        cur = key
+        while True:
+            p = parent.get(cur)
+            if p is None:
+                break
+            hops.append(p)
+            cur = p[0]
+        hops.reverse()
+        return hops
+
+
+# ---------------------------------------------------------------------------
+# DET007: interprocedural entropy taint into the determinism plane
+# ---------------------------------------------------------------------------
+
+
+def _entropy_call_dotted(dotted: Optional[str]) -> bool:
+    if dotted is None:
+        return False
+    return (
+        dotted in _DET001_EXACT
+        or dotted.split(".")[0] in _DET001_MODULES
+    )
+
+
+class _TaintScan:
+    """Per-function local-taint walk shared by the summary fixpoint
+    and the finding pass.  ``summaries`` maps node key ->
+    returns_entropy; ``provenance`` (finding pass only) records where
+    each tainted name's entropy came from."""
+
+    def __init__(
+        self,
+        graph: CallGraph,
+        ctx: FileContext,
+        fnode: FuncNode,
+        summaries: Dict[Tuple[str, str], bool],
+        sanctioned_lines: FrozenSet[int],
+    ) -> None:
+        self.graph = graph
+        self.ctx = ctx
+        self.fnode = fnode
+        self.summaries = summaries
+        self.sanctioned = sanctioned_lines
+        self.tainted: Set[str] = set()
+        self.provenance: Dict[str, Tuple[str, int, str]] = {}
+        self.returns_entropy = False
+        self.sinks: List[Tuple[ast.AST, str, Tuple[str, int, str]]] = []
+
+    def _call_is_entropy(
+        self, call: ast.Call
+    ) -> Optional[Tuple[str, int, str]]:
+        """(path, line, what) of the entropy origin, or None."""
+        if call.lineno in self.sanctioned:
+            return None
+        dotted = self.ctx.resolve(call.func)
+        if _entropy_call_dotted(dotted):
+            return (
+                self.fnode.relpath,
+                call.lineno,
+                f"entropy source {dotted}() called here",
+            )
+        # a call to an entropy-returning function (exact or UNIQUE
+        # name match: ambiguity must not spread taint)
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            name, recv = fn.id, None
+        elif isinstance(fn, ast.Attribute):
+            name, recv = fn.attr, _dotted_expr(fn.value)
+        else:
+            return None
+        site = CallSite(
+            name=name,
+            recv=recv,
+            dotted=dotted,
+            line=call.lineno,
+            col=call.col_offset,
+            held=frozenset(),
+            node=call,
+        )
+        targets, exact = resolve_call(self.graph, self.fnode, site)
+        if not exact and len(targets) != 1:
+            return None
+        for tkey in targets[:1]:
+            if self.summaries.get(tkey):
+                tnode = self.graph.nodes[tkey]
+                return (
+                    tnode.relpath,
+                    tnode.line,
+                    f"{tnode.qual}() returns an entropy-derived "
+                    "value (defined here)",
+                )
+        return None
+
+    def _expr_taint(
+        self, expr: ast.AST
+    ) -> Optional[Tuple[str, int, str]]:
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Call):
+                origin = self._call_is_entropy(n)
+                if origin is not None:
+                    return origin
+            elif isinstance(n, ast.Name) and n.id in self.tainted:
+                return self.provenance.get(
+                    n.id,
+                    (self.fnode.relpath, getattr(n, "lineno", 0),
+                     f"tainted local {n.id!r}"),
+                )
+        return None
+
+    def _assign(
+        self, targets: List[ast.AST], value: ast.AST
+    ) -> None:
+        origin = self._expr_taint(value)
+        for t in targets:
+            if isinstance(t, ast.Name):
+                if origin is not None:
+                    self.tainted.add(t.id)
+                    self.provenance[t.id] = origin
+                else:
+                    self.tainted.discard(t.id)
+                    self.provenance.pop(t.id, None)
+            elif (
+                origin is not None
+                and isinstance(t, ast.Attribute)
+                and isinstance(t.value, ast.Name)
+                and t.value.id == "self"
+            ):
+                self.sinks.append((t, t.attr, origin))
+
+    def _check_call_args(self, call: ast.Call) -> None:
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            name, recv = fn.id, None
+        elif isinstance(fn, ast.Attribute):
+            name, recv = fn.attr, _dotted_expr(fn.value)
+        else:
+            return
+        args = list(call.args) + [kw.value for kw in call.keywords]
+        tainted_origin = None
+        for a in args:
+            tainted_origin = self._expr_taint(a)
+            if tainted_origin is not None:
+                break
+        if tainted_origin is None:
+            return
+        site = CallSite(
+            name=name,
+            recv=recv,
+            dotted=self.ctx.resolve(fn),
+            line=call.lineno,
+            col=call.col_offset,
+            held=frozenset(),
+            node=call,
+        )
+        targets, exact = resolve_call(self.graph, self.fnode, site)
+        if not exact and len(targets) != 1:
+            return
+        for tkey in targets[:1]:
+            tnode = self.graph.nodes[tkey]
+            if tnode.in_plane:
+                self.sinks.append(
+                    (call, f"{tnode.qual}()", tainted_origin)
+                )
+
+    def run(self) -> None:
+        def visit(node: ast.AST) -> None:
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                return  # nested defs scanned as their own nodes
+            if isinstance(node, ast.Assign):
+                for child in ast.walk(node.value):
+                    if isinstance(child, ast.Call):
+                        self._check_call_args(child)
+                self._assign(node.targets, node.value)
+                return
+            if isinstance(node, ast.AnnAssign) and node.value is not None:
+                self._assign([node.target], node.value)
+                return
+            if isinstance(node, ast.AugAssign):
+                origin = self._expr_taint(node.value)
+                if (
+                    origin is not None
+                    and isinstance(node.target, ast.Attribute)
+                    and isinstance(node.target.value, ast.Name)
+                    and node.target.value.id == "self"
+                ):
+                    self.sinks.append(
+                        (node.target, node.target.attr, origin)
+                    )
+                return
+            if isinstance(node, ast.Return) and node.value is not None:
+                if self._expr_taint(node.value) is not None:
+                    self.returns_entropy = True
+            if isinstance(node, ast.Call):
+                self._check_call_args(node)
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+
+        for stmt in self.fnode.fn.body:
+            visit(stmt)
+
+
+_DETERMINISM_MODULE_SUFFIX = "utils/determinism.py"
+
+
+@rule
+class Det007EntropyTaintFlow:
+    id = "DET007"
+    doc = (
+        "no value derived from a non-utils.determinism randomness or "
+        "wall-clock source (directly or through any chain of "
+        "returning functions) may be stored into determinism-plane "
+        "instance state or passed into a determinism-plane function"
+    )
+
+    def check_program(
+        self, index, ctx_map: Dict[str, FileContext]
+    ) -> Iterator[Finding]:
+        graph = _graph_for(ctx_map)
+        sanctioned = self._sanctioned_lines(ctx_map)
+        summaries = self._summaries(graph, ctx_map, sanctioned)
+        for key in sorted(graph.nodes):
+            fnode = graph.nodes[key]
+            if not fnode.in_plane:
+                continue
+            ctx = ctx_map.get(fnode.relpath)
+            if ctx is None:
+                continue
+            scan = _TaintScan(
+                graph,
+                ctx,
+                fnode,
+                summaries,
+                sanctioned.get(fnode.relpath, frozenset()),
+            )
+            scan.run()
+            for node, what, origin in scan.sinks:
+                is_attr = isinstance(node, ast.Attribute)
+                if is_attr:
+                    msg = (
+                        f"{fnode.qual}() stores an entropy-derived "
+                        f"value into self.{what}; determinism-plane "
+                        "state must come from seeded inputs (route "
+                        "sanctioned entropy through "
+                        "utils.determinism)"
+                    )
+                else:
+                    msg = (
+                        f"{fnode.qual}() passes an entropy-derived "
+                        f"value into determinism-plane {what}; "
+                        "seed it via utils.determinism instead"
+                    )
+                yield Finding(
+                    rule=self.id,
+                    path=fnode.relpath,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    message=msg,
+                    snippet=ctx.source_line(node.lineno),
+                    related=(origin,),
+                )
+
+    @staticmethod
+    def _sanctioned_lines(
+        ctx_map: Dict[str, FileContext]
+    ) -> Dict[str, FrozenSet[int]]:
+        """Lines whose entropy is pragma-sanctioned (a justified
+        allow[DET001] or allow[DET007], line or file scope) do not
+        seed taint: the pragma already owns the exception."""
+        out: Dict[str, FrozenSet[int]] = {}
+        for relpath, ctx in ctx_map.items():
+            p = parse_pragmas(ctx)
+            if p.file_allows & {"DET001", "DET007"}:
+                out[relpath] = frozenset(
+                    range(1, len(ctx.lines) + 1)
+                )
+                continue
+            lines = {
+                ln
+                for ln, rules_ in p.line_allows.items()
+                if rules_ & {"DET001", "DET007"}
+            }
+            if lines:
+                out[relpath] = frozenset(lines)
+        return out
+
+    @staticmethod
+    def _summaries(
+        graph: CallGraph,
+        ctx_map: Dict[str, FileContext],
+        sanctioned: Dict[str, FrozenSet[int]],
+    ) -> Dict[Tuple[str, str], bool]:
+        """returns-entropy per node, to fixpoint.  utils.determinism
+        defs are forced non-entropy: that module IS the sanctioned
+        doorway (seeded rngs derived from os entropy at the
+        operator's explicit request)."""
+        summaries: Dict[Tuple[str, str], bool] = {
+            key: False for key in graph.nodes
+        }
+        for _round in range(12):
+            changed = False
+            for key in sorted(graph.nodes):
+                if summaries[key]:
+                    continue
+                fnode = graph.nodes[key]
+                if fnode.relpath.endswith(
+                    _DETERMINISM_MODULE_SUFFIX
+                ):
+                    continue
+                ctx = ctx_map.get(fnode.relpath)
+                if ctx is None:
+                    continue
+                scan = _TaintScan(
+                    graph,
+                    ctx,
+                    fnode,
+                    summaries,
+                    sanctioned.get(fnode.relpath, frozenset()),
+                )
+                scan.run()
+                if scan.returns_entropy:
+                    summaries[key] = True
+                    changed = True
+            if not changed:
+                break
+        return summaries
+
+
+__all__ = [
+    "CallGraph",
+    "CallSite",
+    "Conc003CallerHoldsLock",
+    "Conc004BlockingReachability",
+    "Det007EntropyTaintFlow",
+    "FuncNode",
+    "build_callgraph",
+    "resolve_call",
+]

@@ -66,6 +66,11 @@ class Finding:
     col: int  # 0-based (ast convention)
     message: str
     snippet: str = ""  # stripped source line: the baseline key part
+    # interprocedural findings carry their evidence chain: (path,
+    # line, note) triples rendered as SARIF relatedLocations, so a
+    # CONC003/CONC004 hit is debuggable from the report alone.  NOT
+    # part of the baseline key (chains drift with unrelated edits).
+    related: Tuple[Tuple[str, int, str], ...] = ()
 
     @property
     def key(self) -> str:
