@@ -861,6 +861,58 @@ def wan_section(backend: str) -> dict:
     }
 
 
+def ingress_section() -> dict:
+    """Client-visible latency under open-loop ingress load (ISSUE 18):
+    a seeded Pareto-bursty client population driven through the
+    production admission path (tools/loadgen.py — in-proc twin of the
+    client gRPC surface, fee-priority mempool, channel transport),
+    one arm per pipeline depth in {1, 4} over the IDENTICAL arrival
+    schedule.  Headlines are submit->ordered and submit->settled
+    p50/p99 plus sustained settled tx/s; the harness asserts zero
+    lost acks and byte-identical settled content across arms before
+    reporting anything.  A wan-composed arm (the PR-16 link model
+    under the same load) rides along at depth 4.  CPU-plane only —
+    the admission path runs in the scheduler, not on the chip."""
+    from tools import loadgen
+
+    schedule = loadgen.build_schedule(
+        clients=20_000, txs=6_000, ticks=24, seed=7
+    )
+    arms = {}
+    for depth in (1, 4):
+        a = loadgen.run_arm(
+            schedule, depth=depth, n=4, batch=256, seed=7
+        )
+        arms[f"depth{depth}"] = {
+            k: a[k]
+            for k in (
+                "submit_to_ordered_ms", "submit_to_settled_ms",
+                "tx_per_s", "settled", "evicted", "epochs",
+                "ledger_digest",
+            )
+        }
+    digests = {a["ledger_digest"] for a in arms.values()}
+    assert len(digests) == 1, f"ingress arms diverged: {arms}"
+    wan = loadgen.run_arm(
+        schedule, depth=4, n=4, batch=256, seed=7,
+        wan_profile="wan_3region",
+    )
+    arms["depth4_wan_3region"] = {
+        k: wan[k]
+        for k in (
+            "submit_to_ordered_ms", "submit_to_settled_ms",
+            "tx_per_s", "settled", "ledger_digest",
+        )
+    }
+    return {
+        "clients": 20_000,
+        "txs": 6_000,
+        "mode": "open-loop Pareto arrivals via the in-proc ingress "
+        "twin (tools/loadgen.py); arms share one seeded schedule",
+        "arms": arms,
+    }
+
+
 # ---------------------------------------------------------------------------
 # harness: subprocess isolation + relay probing + guaranteed JSON output
 # ---------------------------------------------------------------------------
@@ -1040,6 +1092,12 @@ def run_child() -> None:
     # model runs in the scheduler, not on the chip).
     progress("wan_scenarios")
     out["wan_scenarios"] = wan_section(cpu_ref)
+    # ingress load (ISSUE 18): client-visible submit->ordered /
+    # submit->settled latency through the production admission path,
+    # depth arms over one seeded schedule + a wan-composed arm.
+    # Scheduler-plane like wan_scenarios — cpu only.
+    progress("ingress_load")
+    out["ingress_load"] = ingress_section()
     progress("modexp_wide")
     if on_tpu:
         # first time these wide-limb programs meet a real chip: a

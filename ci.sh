@@ -34,30 +34,35 @@
 #      DETERMINISTIC hub-dispatch count, and per-stage wall shares
 #      against the trailing BENCH_TREND.jsonl records with noise
 #      bands; the first run seeds the trend file (always passes)
-#   5. fast test tier      — pytest minus the multi-minute scale
+#   5. ingress smoke load  — tools/loadgen.py --smoke: a seeded
+#      open-loop client band through the production admission path
+#      (ingress twin + fee-priority mempool); zero lost acks,
+#      settled ⊇ ordered at drain, and byte-identical settled
+#      content across pipeline depths gate the merge (ISSUE 18)
+#   6. fast test tier      — pytest minus the multi-minute scale
 #      tests, under tools/covgate.py (PEP 669 line coverage; the
 #      tier must execute >= 85% of the package's executable lines —
 #      the travis pipeline's coverage upload, translated to a GATE)
-#   6. race-analog tier    — the seeded deterministic-scheduler suites
+#   7. race-analog tier    — the seeded deterministic-scheduler suites
 #      (transport/byzantine), this stack's answer to `-race`
 #      (SURVEY.md §5.2: replayable interleavings instead of a dynamic
 #      race detector), plus the real-thread gRPC suite
-#   7. lock sanitizer      — the lock-sensitive tier-1 subset +
+#   8. lock sanitizer      — the lock-sensitive tier-1 subset +
 #      a 20-seed fuzz band re-run under CLEISTHENES_LOCKCHECK=1: the
 #      runtime @guarded_by sanitizer (utils/lockcheck.py, the dynamic
 #      twin of CONC001/CONC003) asserts every guarded attribute
 #      access holds its declared lock; zero violations gate
-#   8. fault tier          — the crash/partition/adversary suite
+#   9. fault tier          — the crash/partition/adversary suite
 #      (`-m faults`: Byzantine coalitions, crash+WAL-restart+CATCHUP,
 #      gRPC backoff redial) replayed over a fixed 3-seed matrix, so a
 #      fault-handling regression on ANY matrix seed gates the merge
-#   9. fuzz smoke          — tools/fuzz.py over a fixed seed band:
+#  10. fuzz smoke          — tools/fuzz.py over a fixed seed band:
 #      composite semantic (protocol/byzantine) + wire (Coalition) +
 #      crash/partition schedules on seeded 4-node clusters, safety
 #      invariants checked at every quiescence point; a violation
 #      shrinks to a minimal replayable repro.  The deep band (200
 #      seeds) rides the slow tier (tests/test_fuzz.py)
-#  10. full tier           — everything, including the N=64 slow test
+#  11. full tier           — everything, including the N=64 slow test
 #      (skipped when CI_FAST=1)
 #
 # Usage:  ./ci.sh          # full gate
@@ -66,35 +71,46 @@
 set -euo pipefail
 cd "$(dirname "$0")"
 
-echo "== [1/10] syntax + format gate"
+echo "== [1/11] syntax + format gate"
 python -m compileall -q cleisthenes_tpu tests bench.py __graft_entry__.py
 python tools/format_gate.py
 
-echo "== [2/10] staticcheck gate: whole-program registry + determinism plane"
+echo "== [2/11] staticcheck gate: whole-program registry + determinism plane"
 python -m tools.staticcheck cleisthenes_tpu tools tests --audit-pragmas
 
-echo "== [3/10] observability gate: traced seeded cluster -> tracetool --validate"
+echo "== [3/11] observability gate: traced seeded cluster -> tracetool --validate"
 TRACE_ARTIFACT="$(mktemp /tmp/cleisthenes_trace_ci.XXXXXX.json)"
 trap 'rm -f "$TRACE_ARTIFACT"' EXIT
 JAX_PLATFORMS=cpu python -m tools.tracetool \
     --capture "$TRACE_ARTIFACT" --n 4 --seed 7 --txs 24
 python -m tools.tracetool "$TRACE_ARTIFACT" --validate
 
-echo "== [4/10] perf-regression gate: seeded mini-bench vs BENCH_TREND.jsonl"
+echo "== [4/11] perf-regression gate: seeded mini-bench vs BENCH_TREND.jsonl"
 # seeded traced mini-bench through tools/perfgate.py; seeds the trend
 # on the first run, gates epoch-p50 / dispatch-count / stage-share
 # regressions (noise-banded) on every later run and appends on pass
 JAX_PLATFORMS=cpu python -m tools.perfgate --trend BENCH_TREND.jsonl
 
-echo "== [5/10] fast tests (with coverage gate)"
+echo "== [5/11] ingress smoke load: seeded open-loop client band"
+# tools/loadgen.py --smoke (ISSUE 18): a seconds-scale seeded Pareto
+# client population driven through the production admission path (the
+# in-proc twin of the client gRPC surface + fee-priority mempool).
+# The harness asserts zero lost acks (every OK-acked tx settles
+# exactly once or is accounted by the eviction counter), the settled
+# frontier catching the ordered frontier at drain, cross-node
+# agreement, and byte-identical settled content across pipeline
+# depths 1 and 4 before reporting any latency
+JAX_PLATFORMS=cpu python -m tools.loadgen --smoke
+
+echo "== [6/11] fast tests (with coverage gate)"
 COVGATE_MIN="${COVGATE_MIN:-85}" \
     python -m pytest tests/ -q -m "not slow" -x -p tools.covgate
 
-echo "== [6/10] race-analog: seeded-scheduler + threaded-transport suites"
+echo "== [7/11] race-analog: seeded-scheduler + threaded-transport suites"
 python -m pytest tests/test_transport.py tests/test_byzantine.py \
     tests/test_semantic_byzantine.py tests/test_grpc.py -q -x -m "not slow"
 
-echo "== [7/10] lock sanitizer: @guarded_by runtime assertions armed"
+echo "== [8/11] lock sanitizer: @guarded_by runtime assertions armed"
 # the same annotation registry staticcheck proves statically, watched
 # dynamically: every guarded attribute access must hold its declared
 # lock (utils/lockcheck.py); the lock-sensitive suites + one fuzz
@@ -108,7 +124,7 @@ CLEISTHENES_LOCKCHECK=1 JAX_PLATFORMS=cpu python -m tools.fuzz \
     --seeds 0:20 --out "$LOCKCHECK_FUZZ_OUT"
 rm -rf "$LOCKCHECK_FUZZ_OUT"
 
-echo "== [8/10] fault gate: crash/partition/adversary suite, 3-seed matrix"
+echo "== [9/11] fault gate: crash/partition/adversary suite, 3-seed matrix"
 # the full faults-marked suite already ran at the default seed in
 # stages 4-5; the matrix replays the FAULT_SEED-parametrized
 # crash+WAL-restart+CATCHUP scenario (the seed-sensitive entry point)
@@ -119,7 +135,7 @@ for seed in 11 23 47; do
         -m faults -k crash_restart_wal_catchup
 done
 
-echo "== [9/10] fuzz smoke: semantic+wire schedule fuzzer, 20-seed band"
+echo "== [10/11] fuzz smoke: semantic+wire schedule fuzzer, 20-seed band"
 # 4-node seeded clusters, composite behavior/wire/crash schedules;
 # any invariant violation exits non-zero, leaving the shrunken repro
 # + trace artifact in FUZZ_OUT (cleaned only on success)
@@ -150,12 +166,22 @@ JAX_PLATFORMS=cpu python -m tools.fuzz --seeds 10:20 \
 # tests/test_fuzz.py::test_fuzz_wan_deep_sweep)
 JAX_PLATFORMS=cpu python -m tools.fuzz --seeds 0:20 --wan \
     --out "$FUZZ_OUT"
+# client-ingress band (ISSUE 18): every tx submits through the
+# in-proc twin of the client gRPC surface — encoded client frames ->
+# IngressPlane -> fee-priority mempool — with capacity/client-cap/
+# dup schedules drawn from the seed (appended LAST, extending the
+# historical stream); gates the settle-exactly-once invariant: every
+# acked-and-unevicted tx settles exactly once, dedup/backpressure
+# acks honor the admission contract, and subscribe(0) replays the
+# settled epochs gap-free
+JAX_PLATFORMS=cpu python -m tools.fuzz --seeds 0:20 --ingress \
+    --out "$FUZZ_OUT"
 rm -rf "$FUZZ_OUT"
 
 if [[ "${CI_FAST:-0}" == "1" ]]; then
-    echo "== [10/10] skipped (CI_FAST=1)"
+    echo "== [11/11] skipped (CI_FAST=1)"
 else
-    echo "== [10/10] full suite incl. scale tests"
+    echo "== [11/11] full suite incl. scale tests"
     python -m pytest tests/ -q -m slow
 fi
 

@@ -281,6 +281,29 @@ class Config:
     # past the activation boundary can have been ordered under the
     # OLD roster — the switch point is clean on every honest node.
     reconfig_lead: int = 8
+    # --- ingress plane (transport/ingress.py + core/mempool.py) ---
+    # mempool_capacity > 0 mounts the fee-priority mempool ahead of
+    # the FIFO TxQueue: client submissions admit through it (dedup,
+    # per-client + global backpressure, priority eviction) and batch
+    # selection drains it highest-fee-first into the TxQueue seam.
+    # 0 disables the mempool: add_transaction feeds the TxQueue
+    # directly, exactly the pre-ingress behavior.
+    mempool_capacity: int = 0
+    # per-client pending cap: a client with this many unsettled
+    # admitted txs gets RETRY_AFTER (open-loop fairness: one hot
+    # client cannot monopolize the global capacity).
+    mempool_client_cap: int = 64
+    # bounded ingress-side seen-set (digest ring): resubmits of
+    # pending or recently-settled txs ack DUPLICATE without re-entry.
+    # Coordinated with (not replacing) the settle-time dedup filter:
+    # this ring is the fast front-door check, the committed-history
+    # filter at batch selection remains the authoritative one.
+    mempool_seen_cap: int = 1 << 16
+    # the RETRY_AFTER hint handed to backpressured clients, in ms.
+    mempool_retry_after_ms: int = 100
+    # TCP port for the client-facing gRPC ingress service (None =
+    # no listener; the in-process twin is always available).
+    ingress_port: Optional[int] = None
 
     def __post_init__(self) -> None:
         if self.n < 1:
@@ -358,6 +381,32 @@ class Config:
                 "roster switch point must land past every epoch the "
                 "old roster could already have ordered or still have "
                 "in flight in the K-deep window)"
+            )
+        if self.mempool_capacity < 0:
+            raise ValueError(
+                f"mempool_capacity={self.mempool_capacity} must be "
+                ">= 0 (0 disables the mempool)"
+            )
+        if self.mempool_client_cap < 1:
+            raise ValueError(
+                f"mempool_client_cap={self.mempool_client_cap} must "
+                "be >= 1"
+            )
+        if self.mempool_seen_cap < 1:
+            raise ValueError(
+                f"mempool_seen_cap={self.mempool_seen_cap} must be >= 1"
+            )
+        if self.mempool_retry_after_ms < 0:
+            raise ValueError(
+                f"mempool_retry_after_ms={self.mempool_retry_after_ms} "
+                "must be >= 0"
+            )
+        if self.ingress_port is not None and not (
+            0 <= self.ingress_port <= 65535
+        ):
+            raise ValueError(
+                f"ingress_port={self.ingress_port} must be None or "
+                "0..65535"
             )
         if self.mesh_shape is not None:
             from cleisthenes_tpu.parallel.mesh import validate_mesh_shape

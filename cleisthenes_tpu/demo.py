@@ -73,6 +73,18 @@ def main(argv=None) -> int:
         "BASE+i; 0 picks ephemeral ports (printed at boot; grpc "
         "mode only — see docs/OBSERVABILITY.md)",
     )
+    ap.add_argument(
+        "--ingress-port",
+        type=int,
+        default=None,
+        metavar="BASE",
+        help="serve the client submit/subscribe API "
+        "(transport/ingress.py) on 127.0.0.1: node i listens on "
+        "BASE+i; 0 picks ephemeral ports (printed at boot).  The "
+        "demo then submits its transactions as a real gRPC client "
+        "through the fee-priority mempool instead of in-process "
+        "(grpc mode only — see docs/ARCHITECTURE.md 'Ingress plane')",
+    )
     args = ap.parse_args(argv)
     if args.obs_port is not None and (
         args.obs_port < 0 or args.obs_port + args.n - 1 > 65535
@@ -80,6 +92,13 @@ def main(argv=None) -> int:
         ap.error(
             f"--obs-port {args.obs_port}: need 0 (ephemeral) or a base "
             f"with BASE+{args.n - 1} <= 65535 (one port per node)"
+        )
+    if args.ingress_port is not None and (
+        args.ingress_port < 0 or args.ingress_port + args.n - 1 > 65535
+    ):
+        ap.error(
+            f"--ingress-port {args.ingress_port}: need 0 (ephemeral) "
+            f"or a base with BASE+{args.n - 1} <= 65535 (one per node)"
         )
     configure_logging(logging.DEBUG if args.verbose else logging.INFO)
 
@@ -109,6 +128,12 @@ def main(argv=None) -> int:
                 "== note: --obs-port serves per-validator telemetry; "
                 "lockstep mode has no per-node metrics (flag ignored)"
             )
+        if args.ingress_port is not None:
+            print(
+                "== note: --ingress-port serves the per-validator "
+                "client API; lockstep mode has no per-node transport "
+                "(flag ignored)"
+            )
         return _lockstep_main(args, cfg)
     keys = setup_keys(cfg, ids)
     if args.dkg:
@@ -117,14 +142,25 @@ def main(argv=None) -> int:
         os.makedirs(args.log_dir, exist_ok=True)
 
     def node_cfg(rank: int) -> Config:
-        """Per-node config: telemetry ports fan out from the base
-        (--obs-port 9100 -> node i scrapes at 9100+i; 0 = ephemeral)."""
-        if args.obs_port is None:
+        """Per-node config: telemetry and ingress ports fan out from
+        their bases (--obs-port 9100 -> node i scrapes at 9100+i;
+        0 = ephemeral).  --ingress-port also mounts the fee-priority
+        mempool the client API admits into."""
+        if args.obs_port is None and args.ingress_port is None:
             return cfg
         import dataclasses
 
-        port = args.obs_port + rank if args.obs_port > 0 else 0
-        return dataclasses.replace(cfg, obs_port=port)
+        fields = {}
+        if args.obs_port is not None:
+            fields["obs_port"] = (
+                args.obs_port + rank if args.obs_port > 0 else 0
+            )
+        if args.ingress_port is not None:
+            fields["ingress_port"] = (
+                args.ingress_port + rank if args.ingress_port > 0 else 0
+            )
+            fields["mempool_capacity"] = max(1024, 4 * args.batch_size)
+        return dataclasses.replace(cfg, **fields)
 
     hosts = {
         i: ValidatorHost(
@@ -147,6 +183,12 @@ def main(argv=None) -> int:
             i: f"127.0.0.1:{h.obs.port}" for i, h in hosts.items()
         }
         print(f"== telemetry (/metrics /healthz /vars): {obs_addrs}")
+    if args.ingress_port is not None:
+        ingress_addrs = {
+            i: f"127.0.0.1:{h.ingress_server.port}"
+            for i, h in hosts.items()
+        }
+        print(f"== client ingress (submit/subscribe): {ingress_addrs}")
     threads = [
         threading.Thread(target=h.connect, args=(addrs,))
         for h in hosts.values()
@@ -162,8 +204,28 @@ def main(argv=None) -> int:
     # dup-filtered by design)
     prefix = b"demo-%d" % time.time_ns()
     txs = [b"%s-tx-%05d" % (prefix, i) for i in range(args.txs)]
-    for i, tx in enumerate(txs):
-        hosts[ids[i % args.n]].submit(tx)
+    if args.ingress_port is not None:
+        # real client path: submit over the ingress gRPC API through
+        # the fee-priority mempool, one pipelined stream per node
+        from cleisthenes_tpu.transport.ingress import IngressGrpcClient
+
+        ok = 0
+        for rank, nid in enumerate(ids):
+            client = IngressGrpcClient(
+                f"127.0.0.1:{hosts[nid].ingress_server.port}"
+            )
+            batch = [
+                (f"demo-client-{i % 8}", i, 1 + i % 5, tx)
+                for i, tx in enumerate(txs)
+                if i % args.n == rank
+            ]
+            acks = client.submit_many(batch)
+            ok += sum(1 for a in acks if int(a.status) == 0)
+            client.close()
+        print(f"== ingress: {ok}/{len(txs)} submits acked OK")
+    else:
+        for i, tx in enumerate(txs):
+            hosts[ids[i % args.n]].submit(tx)
 
     committed = set()
     t0 = time.monotonic()

@@ -204,6 +204,8 @@ class SimulatedCluster:
             if self.net.wan is not None:
                 hb.metrics.set_wan_stats(self.net.wan.stats)
         self._rr = 0  # submit() round-robin cursor
+        # lazily-built per-node ingress planes (see ingress())
+        self._ingress_planes: Dict[str, object] = {}
         # SLO watchdog plane (utils/watchdog.py): one per node, peer
         # state from the channel network's fault view (crash/partition)
         # and peer LAG from the epoch frontiers the in-proc cluster can
@@ -273,6 +275,26 @@ class SimulatedCluster:
     def pending(self) -> int:
         return sum(hb.pending_tx_count() for hb in self.nodes.values())
 
+    def ingress(self, node_id: Optional[str] = None):
+        """The in-process twin of the client gRPC surface: an
+        ``InProcIngressClient`` over ``node_id``'s IngressPlane
+        (transport/ingress.py), round-tripping the identical encoded
+        client frames through the identical admission/subscription
+        code — minus the sockets.  Needs a mounted mempool
+        (Config.mempool_capacity > 0); the plane is built lazily and
+        cached per node."""
+        from cleisthenes_tpu.transport.ingress import (
+            InProcIngressClient,
+            IngressPlane,
+        )
+
+        nid = node_id or self.ids[0]
+        plane = self._ingress_planes.get(nid)
+        if plane is None:
+            plane = IngressPlane(self.nodes[nid])
+            self._ingress_planes[nid] = plane
+        return InProcIngressClient(plane)
+
     def run_until_drained(
         self,
         max_rounds: int = 50,
@@ -336,6 +358,11 @@ class SimulatedCluster:
         old = self.nodes[nid]
         if old.batch_log is not None:
             old.batch_log.close()
+        # the old ingress plane (if any) holds the dead node; drop it
+        # so the next ingress() call builds one over the restarted node
+        stale_plane = self._ingress_planes.pop(nid, None)
+        if stale_plane is not None:
+            stale_plane.close()
         params = self._node_params[nid]
         auth = HmacAuthenticator(nid, self.keys[nid].mac_keys)
         self.auths[nid] = auth

@@ -632,6 +632,33 @@ class HoneyBadger:
         # (bounded: one entry per remembered epoch)
         self._committed_filter: Set[bytes] = set()
         self._committed_history: List[Set[bytes]] = []
+        # -- ingress plane (core.mempool + transport.ingress) ------------
+        # The fee-priority admission pool ahead of the TxQueue seam:
+        # client submissions admit through it (dedup / backpressure /
+        # priority eviction) and _create_batch drains it highest-fee-
+        # first into self.que.  mempool_capacity=0 keeps the exact
+        # pre-ingress shape: add_transaction -> TxQueue directly.
+        self.mempool = None
+        if config.mempool_capacity > 0:
+            from cleisthenes_tpu.core.mempool import Mempool
+
+            self.mempool = Mempool(
+                capacity=config.mempool_capacity,
+                client_cap=config.mempool_client_cap,
+                seen_cap=config.mempool_seen_cap,
+                retry_after_ms=config.mempool_retry_after_ms,
+                seed=config.seed if config.seed is not None else 0,
+                on_evict=self._mempool_evicted,
+            )
+        self.metrics.set_ingress(self._ingress_block)
+        # committed-batch fan-out beyond the single on_commit slot:
+        # the ingress plane's subscription server registers here (one
+        # listener per live subscriber feed), while on_commit stays
+        # the transport host's private hook
+        self._commit_listeners: List[Callable[[int, Batch], None]] = []
+        # the ingress subscription server's live-feed gauge (None
+        # until a subscription server mounts)
+        self._subscriber_count: Optional[Callable[[], int]] = None
         # -- dynamic membership (protocol.reconfig) ----------------------
         # Versioned rosters: v0 is the construction-time roster; every
         # later version installs from a committed RECONFIG ceremony.
@@ -791,6 +818,81 @@ class HoneyBadger:
             raise TypeError("transactions are opaque bytes")
         self.que.push(bytes(tx))
 
+    # -- ingress plane (core.mempool + transport.ingress) ------------------
+
+    def submit_ingress(self, client_id: str, fee: int, tx: bytes):
+        """Admit one client transaction through the mempool (the
+        ingress plane's policy call; transport/ingress.py wraps the
+        verdict in an IngressAckPayload).  Requires a mounted mempool
+        (Config.mempool_capacity > 0)."""
+        if self.mempool is None:
+            raise RuntimeError(
+                "no mempool mounted (Config.mempool_capacity=0)"
+            )
+        if not isinstance(tx, (bytes, bytearray)):
+            raise TypeError("transactions are opaque bytes")
+        verdict = self.mempool.admit(bytes(tx), client_id, fee)
+        if self.trace is not None:
+            self.trace.instant(
+                "ingress", "admit", status=verdict.status, fee=fee
+            )
+        return verdict
+
+    def _mempool_evicted(self, digest: bytes, client_id: str) -> None:
+        """Mempool on_evict hook: surface priority evictions on the
+        flight-recorder timeline (the counter itself lives in the
+        mempool and reaches snapshot()["ingress"] via the provider)."""
+        if self.trace is not None:
+            self.trace.instant(
+                "ingress", "evict", digest=digest[:4].hex()
+            )
+
+    def _ingress_block(self) -> Dict[str, object]:
+        """snapshot()["ingress"] provider: mempool admission tallies
+        plus the subscription gauge (zeroed keys when no mempool /
+        no subscription server is mounted)."""
+        out: Dict[str, object] = {}
+        if self.mempool is not None:
+            s = self.mempool.stats()
+            out.update(
+                submitted=s["submitted"],
+                admitted=s["admitted"],
+                rejected=s["rejected"],
+                retried=s["retried"],
+                deduped=s["deduped"],
+                evicted=s["evicted"],
+                mempool_depth=s["depth"],
+            )
+        if self._subscriber_count is not None:
+            out["subscribers"] = self._subscriber_count()
+        return out
+
+    def set_subscriber_provider(
+        self, provider: Optional[Callable[[], int]]
+    ) -> None:
+        """The ingress subscription server's live-feed gauge."""
+        self._subscriber_count = provider
+
+    def add_commit_listener(
+        self, fn: Callable[[int, Batch], None]
+    ) -> None:
+        """Register a committed-batch listener beyond the single
+        on_commit slot (the subscription server's live tail).  Fired
+        after on_commit, in registration order, at every settlement
+        (local or adopted via CATCHUP), strictly in epoch order."""
+        self._commit_listeners.append(fn)
+
+    def _notify_commit(self, epoch: int, batch: Batch) -> None:
+        """The single settlement fan-out point: retire the batch's txs
+        from the mempool's in-flight accounting, then fire on_commit
+        and every registered listener."""
+        if self.mempool is not None:
+            self.mempool.mark_settled(batch.tx_list())
+        if self.on_commit is not None:
+            self.on_commit(epoch, batch)
+        for fn in self._commit_listeners:
+            fn(epoch, batch)
+
     def start_epoch(self, epoch: Optional[int] = None) -> None:
         """Select a batch, encrypt it, and input it to this epoch's ACS
         (the intended body of reference honeybadger.go:57-59 sendBatch).
@@ -876,7 +978,7 @@ class HoneyBadger:
                 es = self._epochs.get(e)
                 if es is not None and es.proposed:
                     continue
-                if len(self.que) > 0 or es is not None:
+                if self._queue_work() or es is not None:
                     self._propose_into(e)
         finally:
             self._pipeline_active = False
@@ -908,8 +1010,20 @@ class HoneyBadger:
         finally:
             self._exit_turn()
 
+    def _queue_work(self) -> bool:
+        """Is there local work to propose?  Queue depth OR mempool
+        entries awaiting their drain into the TxQueue seam — the
+        propose-gating twin of pending_tx_count."""
+        if len(self.que) > 0:
+            return True
+        return (
+            self.mempool is not None and self.mempool.pending_count() > 0
+        )
+
     def pending_tx_count(self) -> int:
-        return len(self.que)
+        if self.mempool is None:
+            return len(self.que)
+        return len(self.que) + self.mempool.pending_count()
 
     def outstanding_tx_count(self) -> int:
         """Queue depth PLUS transactions absorbed into in-flight
@@ -920,8 +1034,14 @@ class HoneyBadger:
         stalled node must still read as holding pending work.
         Called from observability threads (the SLO watchdog's
         pending_fn): list() snapshots the dict against concurrent
-        protocol-thread mutation."""
-        return len(self.que) + sum(
+        protocol-thread mutation.  Mempool entries still awaiting
+        drain count too — client-acked work invisible to the queue
+        and to every epoch's my_txs must still trip the
+        queue-backpressure detector."""
+        staged = (
+            0 if self.mempool is None else self.mempool.pending_count()
+        )
+        return staged + len(self.que) + sum(
             len(es.my_txs)
             for es in list(self._epochs.values())
             if es.proposed and not es.committed
@@ -1200,6 +1320,13 @@ class HoneyBadger:
     # -- batch policy (reference honeybadger.go:62-104) --------------------
 
     def _create_batch(self) -> List[bytes]:
+        # the TxQueue seam: admitted client txs flow highest-fee-first
+        # from the mempool into the FIFO queue AHEAD of candidate
+        # polling, so selection below (and its committed-filter dedup)
+        # is unchanged whether a tx arrived via add_transaction or
+        # through the ingress admission pipeline
+        if self.mempool is not None:
+            self.mempool.drain_into(self.que, self.b)
         candidates = self._load_candidate_txs(min(self.b, len(self.que)))
         # the ACTIVE roster's width (b/n sampling follows the live n)
         n = self.active_view.config.n
@@ -1565,7 +1692,7 @@ class HoneyBadger:
             self.auto_propose
             and self.config.epoch_pipelining
             and epoch == self.epoch
-            and len(self.que) > 0
+            and self._queue_work()
         ):
             self.start_epoch(epoch + 1)
         # share issue AFTER the pipelined next-epoch proposal: the
@@ -2369,8 +2496,7 @@ class HoneyBadger:
         self._reconfig.on_batch_settled(epoch, batch)
         self._maybe_teardown_retired()
         self._serve_owed_plaintext()
-        if self.on_commit is not None:
-            self.on_commit(epoch, batch)
+        self._notify_commit(epoch, batch)
         if self._two_frontier and epoch < self.epoch:
             # plaintext for an epoch we had already ORDERED (restart
             # with an ordered-ahead window, or a settle stall peers
@@ -2538,8 +2664,7 @@ class HoneyBadger:
         # releases the retirees
         self._reconfig.on_batch_settled(epoch, batch)
         self._maybe_teardown_retired()
-        if self.on_commit is not None:
-            self.on_commit(epoch, batch)
+        self._notify_commit(epoch, batch)
         self._serve_owed_plaintext()
 
     def _prune_epoch_states(self) -> None:
@@ -2602,7 +2727,7 @@ class HoneyBadger:
         # roster drives (possibly empty) epochs up to the switch
         # instead of letting a quiescent cluster wedge mid-transition
         if self.auto_propose and (
-            len(self.que) > 0
+            self._queue_work()
             or self.epoch in self._epochs
             or self.epoch < self.rosters.latest().activation_epoch
         ):

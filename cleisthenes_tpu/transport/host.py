@@ -512,6 +512,30 @@ class ValidatorHost:
                 ],
                 port=config.obs_port,
             )
+        # client ingress plane (Config.ingress_port): the untrusted
+        # submit/subscribe surface (transport/ingress.py), fronted by
+        # the fee-priority mempool the node mounted above.  Built
+        # here, bound by listen() next to the validator server.
+        self.ingress = None
+        self.ingress_server = None
+        if config.ingress_port is not None:
+            from cleisthenes_tpu.transport.ingress import (
+                IngressGrpcServer,
+                IngressPlane,
+            )
+
+            # post-admission nudge: an idle node starts an epoch for
+            # fresh client work (start_epoch no-ops mid-epoch, so the
+            # kick is an enqueue + cheap check, never a double propose)
+            self.ingress = IngressPlane(
+                self.node,
+                on_admitted=lambda: self.dispatcher.call(
+                    self.node.start_epoch
+                ),
+            )
+            self.ingress_server = IngressGrpcServer(
+                self.ingress, f"127.0.0.1:{config.ingress_port}"
+            )
         # the dispatcher records queue-depth/wave events on the node's
         # own timeline (same worker thread as all protocol code)
         self.dispatcher.trace = self.node.trace
@@ -580,6 +604,12 @@ class ValidatorHost:
             port = self.obs.start()
             self.sampler.start(self.config.obs_sample_period_s)
             self.log.info("obs endpoints up", addr=f"127.0.0.1:{port}")
+        if self.ingress_server is not None:
+            self.ingress_server.listen()
+            self.log.info(
+                "ingress up",
+                addr=f"127.0.0.1:{self.ingress_server.port}",
+            )
         return addr
 
     def connect(
@@ -773,6 +803,8 @@ class ValidatorHost:
 
     def stop(self) -> None:
         self._stopping.set()
+        if self.ingress_server is not None:
+            self.ingress_server.stop()
         if self.sampler is not None:
             self.sampler.stop()
         if self.obs is not None:

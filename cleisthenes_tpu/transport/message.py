@@ -170,6 +170,71 @@ class CatchupOrdPayload(NamedTuple):
     body: bytes
 
 
+class IngressStatus(enum.IntEnum):
+    """Admission verdict carried in an IngressAckPayload.
+
+    The backpressure contract (docs/ARCHITECTURE.md "Ingress plane"):
+    a submit is never silently dropped — every frame gets exactly one
+    ack, and the non-OK verdicts are distinguishable so a client knows
+    whether to give up (REJECTED), wait (RETRY_AFTER, with a hint), or
+    stop resending (DUPLICATE: the tx is already pending or settled).
+    """
+
+    OK = 0
+    DUPLICATE = 1
+    REJECTED = 2
+    RETRY_AFTER = 3
+
+
+class IngressSubmitPayload(NamedTuple):
+    """One client transaction submission (the ingress plane's front
+    door, transport/ingress.py).  ``client_id`` names the submitting
+    client for per-client backpressure accounting; ``nonce`` is the
+    client's own sequence number, echoed in the ack so a pipelining
+    client can match acks to submits; ``fee`` is the priority bid the
+    mempool orders and evicts by (core/mempool.py)."""
+
+    client_id: str
+    nonce: int
+    fee: int
+    tx: bytes
+
+
+class IngressAckPayload(NamedTuple):
+    """The admitting node's answer to one IngressSubmitPayload:
+    verdict plus the node's two commit frontiers at admission time
+    (ordered_epoch / settled_epoch — the PR-8 two-frontier split), so
+    a client can bound when its tx can first appear in a batch.
+    ``retry_after_ms`` is nonzero only with status RETRY_AFTER."""
+
+    client_id: str
+    nonce: int
+    status: int
+    ordered_epoch: int
+    settled_epoch: int
+    retry_after_ms: int
+
+
+class IngressSubscribePayload(NamedTuple):
+    """Open a committed-batch subscription: "stream me every settled
+    batch from ``from_epoch`` on".  Epochs already settled replay from
+    the node's committed history (the same state the BatchLog restores
+    at startup); later epochs arrive as a live tail at the settled
+    frontier."""
+
+    from_epoch: int
+
+
+class IngressBatchPayload(NamedTuple):
+    """One settled batch streamed to a subscriber (ledger body bytes,
+    core.ledger.encode_batch_body — the same canonical body CATCHUP
+    serves, so subscribers and rejoining validators read one format).
+    """
+
+    epoch: int
+    body: bytes
+
+
 class ResharePayload(NamedTuple):
     """One dealer's reshare dealing for a pending RECONFIG (dynamic
     membership, protocol.reconfig).
@@ -295,6 +360,10 @@ Payload = Union[
     DecShareBatchPayload,
     ReadyBatchPayload,
     EchoBatchPayload,
+    IngressSubmitPayload,
+    IngressAckPayload,
+    IngressSubscribePayload,
+    IngressBatchPayload,
 ]
 
 # oneof discriminants (reference message.proto:18-22 has rbc=3, bba=4;
@@ -317,6 +386,17 @@ _KIND_READY_BATCH = 13  # staticcheck: allow[WIRE001] native-only columnar kind 
 _KIND_ECHO_BATCH = 14  # staticcheck: allow[WIRE001] native-only columnar kind (wave coalescing)
 _KIND_CATCHUP_ORD = 15
 _KIND_RESHARE = 16
+# client ingress plane (transport/ingress.py): submit/subscribe frames
+# exchanged with UNTRUSTED clients.  They ride the same TLV codec (and
+# pb extension slots, for stock-decoder interop) but a different frame
+# magic (_INGRESS_MAGIC) with no envelope MAC: clients hold no roster
+# keys, and admission control — not authentication — is the guard.
+# Ingress frames therefore never enter the validator-to-validator
+# dispatch path (VERIFY001's decode->verify->serve discipline).
+_KIND_INGRESS_SUBMIT = 17
+_KIND_INGRESS_ACK = 18
+_KIND_INGRESS_SUB = 19
+_KIND_INGRESS_BATCH = 20
 
 # DoS bound on per-instance columns (a roster is <= 256 under the
 # GF(2^8) shard cap; 4096 leaves margin for multi-round merges)
@@ -471,6 +551,31 @@ def _encode_payload(p: Payload) -> Tuple[int, bytes]:
         _pack_str(out, p.dealer)
         _pack_bytes(out, p.body)
         return _KIND_RESHARE, b"".join(out)
+    if isinstance(p, IngressSubmitPayload):
+        _pack_str(out, p.client_id)
+        out.append(struct.pack(">QQ", p.nonce, p.fee))
+        _pack_bytes(out, p.tx)
+        return _KIND_INGRESS_SUBMIT, b"".join(out)
+    if isinstance(p, IngressAckPayload):
+        _pack_str(out, p.client_id)
+        out.append(
+            struct.pack(
+                ">QBQQI",
+                p.nonce,
+                int(p.status),
+                p.ordered_epoch,
+                p.settled_epoch,
+                p.retry_after_ms,
+            )
+        )
+        return _KIND_INGRESS_ACK, b"".join(out)
+    if isinstance(p, IngressSubscribePayload):
+        out.append(struct.pack(">Q", p.from_epoch))
+        return _KIND_INGRESS_SUB, b"".join(out)
+    if isinstance(p, IngressBatchPayload):
+        out.append(struct.pack(">Q", p.epoch))
+        _pack_bytes(out, p.body)
+        return _KIND_INGRESS_BATCH, b"".join(out)
     if isinstance(p, BundlePayload):
         if len(p.items) > MAX_BUNDLE_ITEMS:
             raise ValueError(f"bundle of {len(p.items)} items exceeds cap")
@@ -783,6 +888,44 @@ def _parse_payload(d: bytes, o: int, end: int, kind: int):
         dealer, o = _field(d, o + 4, end)
         body, o = _field(d, o, end)
         return ResharePayload(version, dealer.decode("utf-8"), body), o
+    if kind == _KIND_INGRESS_SUBMIT:
+        client, o = _field(d, o, end)
+        if o + 16 > end:
+            raise ValueError("truncated frame")
+        (nonce,) = _U64.unpack_from(d, o)
+        (fee,) = _U64.unpack_from(d, o + 8)
+        tx, o = _field(d, o + 16, end)
+        return (
+            IngressSubmitPayload(client.decode("utf-8"), nonce, fee, tx),
+            o,
+        )
+    if kind == _KIND_INGRESS_ACK:
+        client, o = _field(d, o, end)
+        if o + 29 > end:
+            raise ValueError("truncated frame")
+        (nonce,) = _U64.unpack_from(d, o)
+        status = IngressStatus(d[o + 8])
+        (ordered,) = _U64.unpack_from(d, o + 9)
+        (settled,) = _U64.unpack_from(d, o + 17)
+        (retry_ms,) = _U32.unpack_from(d, o + 25)
+        return (
+            IngressAckPayload(
+                client.decode("utf-8"), nonce, status, ordered, settled,
+                retry_ms,
+            ),
+            o + 29,
+        )
+    if kind == _KIND_INGRESS_SUB:
+        if o + 8 > end:
+            raise ValueError("truncated frame")
+        (from_epoch,) = _U64.unpack_from(d, o)
+        return IngressSubscribePayload(from_epoch), o + 8
+    if kind == _KIND_INGRESS_BATCH:
+        if o + 8 > end:
+            raise ValueError("truncated frame")
+        (epoch,) = _U64.unpack_from(d, o)
+        body, o = _field(d, o + 8, end)
+        return IngressBatchPayload(epoch, body), o
     if kind == _KIND_BUNDLE:
         if o + 4 > end:
             raise ValueError("truncated frame")
@@ -1098,6 +1241,55 @@ def decode_message(data: bytes) -> Message:
     return decode_frame(data)[0]
 
 
+# ---------------------------------------------------------------------------
+# client ingress frames
+# ---------------------------------------------------------------------------
+
+_INGRESS_MAGIC = b"CLIN"  # cleisthenes-tpu ingress (client) magic
+
+# the only kinds a client frame may carry, in either direction; any
+# validator-plane kind inside an ingress frame is rejected at decode,
+# so a client can never smuggle protocol payloads past the MAC layer
+_INGRESS_KINDS = frozenset(
+    (
+        _KIND_INGRESS_SUBMIT,
+        _KIND_INGRESS_ACK,
+        _KIND_INGRESS_SUB,
+        _KIND_INGRESS_BATCH,
+    )
+)
+
+
+def encode_client_frame(p: Payload) -> bytes:
+    """One unauthenticated client<->validator ingress frame:
+    ``CLIN | version | kind | TLV body``.  No envelope MAC — clients
+    hold no roster keys; the mempool's admission control (dedup,
+    per-client caps, priority eviction) is the abuse guard, and the
+    gRPC stream supplies the length delimiting."""
+    kind, body = _encode_payload(p)
+    if kind not in _INGRESS_KINDS:
+        raise ValueError(
+            f"payload kind {kind} is not a client ingress kind"
+        )
+    return _INGRESS_MAGIC + struct.pack(">BB", _VERSION, kind) + body
+
+
+def decode_client_frame(data: bytes) -> Payload:
+    """Inverse of ``encode_client_frame``; canonical-or-reject like the
+    validator codec, and restricted to the ingress kind set."""
+    if len(data) < 6 or data[:4] != _INGRESS_MAGIC:
+        raise ValueError("bad ingress magic")
+    version, kind = data[4], data[5]
+    if version != _VERSION:
+        raise ValueError(f"unsupported wire version {version}")
+    if kind not in _INGRESS_KINDS:
+        raise ValueError(f"payload kind {kind} is not a client ingress kind")
+    payload, consumed = _parse_payload(data, 6, len(data), kind)
+    if consumed != len(data):
+        raise ValueError("trailing bytes in ingress frame")
+    return payload
+
+
 __all__ = [
     "Message",
     "Payload",
@@ -1115,8 +1307,15 @@ __all__ = [
     "DecShareBatchPayload",
     "ReadyBatchPayload",
     "EchoBatchPayload",
+    "IngressSubmitPayload",
+    "IngressAckPayload",
+    "IngressSubscribePayload",
+    "IngressBatchPayload",
+    "IngressStatus",
     "RbcType",
     "BbaType",
+    "encode_client_frame",
+    "decode_client_frame",
     "encode_message",
     "decode_message",
     "decode_frame",

@@ -412,6 +412,80 @@ def render_prometheus(targets: Sequence[ObsTarget]) -> str:
             labels,
             int(wan["virtual_time_ms"]) / 1e3,
         )
+        # client ingress-plane counters (always present — zeroed
+        # when no mempool is mounted per the schema rule)
+        ingress = snap["ingress"]
+        exp.add(
+            exp.family(
+                "ingress_submitted_total", "counter",
+                "client transactions offered to the admission stage "
+                "(every one got an explicit ack verdict)",
+            ),
+            labels,
+            int(ingress["submitted"]),
+        )
+        exp.add(
+            exp.family(
+                "ingress_admitted_total", "counter",
+                "submissions admitted into the fee-priority mempool",
+            ),
+            labels,
+            int(ingress["admitted"]),
+        )
+        exp.add(
+            exp.family(
+                "ingress_rejected_total", "counter",
+                "submissions rejected outright (malformed, "
+                "oversized, negative fee)",
+            ),
+            labels,
+            int(ingress["rejected"]),
+        )
+        exp.add(
+            exp.family(
+                "ingress_retried_total", "counter",
+                "submissions answered RETRY_AFTER (per-client cap "
+                "or global pressure — explicit backpressure, never "
+                "a silent drop)",
+            ),
+            labels,
+            int(ingress["retried"]),
+        )
+        exp.add(
+            exp.family(
+                "ingress_deduped_total", "counter",
+                "submissions absorbed by the bounded seen-ring "
+                "(already pending, in flight, or recently settled)",
+            ),
+            labels,
+            int(ingress["deduped"]),
+        )
+        exp.add(
+            exp.family(
+                "ingress_evicted_total", "counter",
+                "pending entries bumped by higher-priority "
+                "newcomers under capacity pressure",
+            ),
+            labels,
+            int(ingress["evicted"]),
+        )
+        exp.add(
+            exp.family(
+                "ingress_subscribers", "gauge",
+                "open committed-batch subscription feeds",
+            ),
+            labels,
+            int(ingress["subscribers"]),
+        )
+        exp.add(
+            exp.family(
+                "ingress_mempool_depth", "gauge",
+                "live mempool entries (pending + drained-in-flight) "
+                "— the depth the queue-backpressure watchdog reads",
+            ),
+            labels,
+            int(ingress["mempool_depth"]),
+        )
         for peer, ph in snap.get("transport_health", {}).items():
             plabels = {**labels, "peer": peer}
             exp.add(

@@ -35,6 +35,10 @@ from cleisthenes_tpu.transport.message import (
     CatchupOrdPayload,
     CatchupReqPayload,
     CatchupRespPayload,
+    IngressAckPayload,
+    IngressBatchPayload,
+    IngressSubmitPayload,
+    IngressSubscribePayload,
     Message,
     Payload,
     RbcPayload,
@@ -43,6 +47,10 @@ from cleisthenes_tpu.transport.message import (
     _KIND_CATCHUP_ORD,
     _KIND_CATCHUP_REQ,
     _KIND_CATCHUP_RESP,
+    _KIND_INGRESS_ACK,
+    _KIND_INGRESS_BATCH,
+    _KIND_INGRESS_SUB,
+    _KIND_INGRESS_SUBMIT,
     _KIND_RBC,
     _KIND_RESHARE,
     _encode_payload,
@@ -75,6 +83,14 @@ _PB_TAG_CATCHUP_ORD = 17
 # dynamic membership: the reshare-dealing gossip kind (same field-1
 # extension shape)
 _PB_TAG_RESHARE = 18
+# client ingress plane (transport/ingress.py): submit/ack/subscribe/
+# batch-event frames, same TLV-in-field-1 extension shape — a stock
+# decoder skips them as unknown fields, so a reference peer simply has
+# no client door (the capability its skeleton never reached)
+_PB_TAG_INGRESS_SUBMIT = 19
+_PB_TAG_INGRESS_ACK = 20
+_PB_TAG_INGRESS_SUB = 21
+_PB_TAG_INGRESS_BATCH = 22
 
 # A Byzantine frame must not make us allocate from a length varint.
 MAX_PB_FIELD = 64 * 1024 * 1024
@@ -171,6 +187,18 @@ def encode_pb_message(msg: Message) -> bytes:
     elif isinstance(p, ResharePayload):
         _k, tlv = _encode_payload(p)
         one = _len_field(_PB_TAG_RESHARE, _len_field(1, tlv))
+    elif isinstance(p, IngressSubmitPayload):
+        _k, tlv = _encode_payload(p)
+        one = _len_field(_PB_TAG_INGRESS_SUBMIT, _len_field(1, tlv))
+    elif isinstance(p, IngressAckPayload):
+        _k, tlv = _encode_payload(p)
+        one = _len_field(_PB_TAG_INGRESS_ACK, _len_field(1, tlv))
+    elif isinstance(p, IngressSubscribePayload):
+        _k, tlv = _encode_payload(p)
+        one = _len_field(_PB_TAG_INGRESS_SUB, _len_field(1, tlv))
+    elif isinstance(p, IngressBatchPayload):
+        _k, tlv = _encode_payload(p)
+        one = _len_field(_PB_TAG_INGRESS_BATCH, _len_field(1, tlv))
     else:
         raise ValueError(
             f"{type(p).__name__} has no slot in the reference's oneof"
@@ -202,6 +230,8 @@ def decode_pb_message(data: bytes, sender_id: str = "") -> Message:
                 1, 2, _PB_TAG_RBC, _PB_TAG_BBA,
                 _PB_TAG_CATCHUP_REQ, _PB_TAG_CATCHUP_RESP,
                 _PB_TAG_CATCHUP_ORD, _PB_TAG_RESHARE,
+                _PB_TAG_INGRESS_SUBMIT, _PB_TAG_INGRESS_ACK,
+                _PB_TAG_INGRESS_SUB, _PB_TAG_INGRESS_BATCH,
             ):
                 raise ValueError(
                     f"wire type {wt} for known tag {tag} (expected LEN)"
@@ -231,6 +261,8 @@ def decode_pb_message(data: bytes, sender_id: str = "") -> Message:
         elif tag in (
             _PB_TAG_CATCHUP_REQ, _PB_TAG_CATCHUP_RESP,
             _PB_TAG_CATCHUP_ORD, _PB_TAG_RESHARE,
+            _PB_TAG_INGRESS_SUBMIT, _PB_TAG_INGRESS_ACK,
+            _PB_TAG_INGRESS_SUB, _PB_TAG_INGRESS_BATCH,
         ):
             payload = _parse_catchup(tag, body)
         # unknown LEN fields are skipped, per proto3 semantics
@@ -263,6 +295,14 @@ def _parse_catchup(tag: int, body: bytes) -> Payload:
         kind = _KIND_CATCHUP_RESP
     elif tag == _PB_TAG_RESHARE:
         kind = _KIND_RESHARE
+    elif tag == _PB_TAG_INGRESS_SUBMIT:
+        kind = _KIND_INGRESS_SUBMIT
+    elif tag == _PB_TAG_INGRESS_ACK:
+        kind = _KIND_INGRESS_ACK
+    elif tag == _PB_TAG_INGRESS_SUB:
+        kind = _KIND_INGRESS_SUB
+    elif tag == _PB_TAG_INGRESS_BATCH:
+        kind = _KIND_INGRESS_BATCH
     else:
         kind = _KIND_CATCHUP_ORD
     return _decode_payload(kind, tlv)

@@ -291,6 +291,10 @@ class Metrics:
         # WanEmulator.stats): folds the virtual-clock plane's tallies
         # into snapshot()["wan"]
         self._wan_stats: Optional[Callable[[], Dict]] = None
+        # ingress-plane provider (set by the owning HoneyBadger:
+        # mempool admission tallies + subscriber gauge) — folds into
+        # the ALWAYS-present zeroed snapshot()["ingress"] block
+        self._ingress: Optional[Callable[[], Dict]] = None
 
     def set_transport_health(
         self, provider: Optional[Callable[[], Dict]]
@@ -333,6 +337,10 @@ class Metrics:
     ) -> None:
         """WAN emulation-plane provider (WanEmulator.stats)."""
         self._wan_stats = provider
+
+    def set_ingress(self, provider: Optional[Callable[[], Dict]]) -> None:
+        """Ingress-plane provider (mempool tallies + subscribers)."""
+        self._ingress = provider
 
     def decrypt_lag_epochs(self) -> int:
         """Ordered frontier - settled frontier (0 when no provider is
@@ -527,6 +535,23 @@ class Metrics:
         if self._wan_stats is not None:
             wan.update(self._wan_stats())
         out["wan"] = wan
+        # ingress block: ALWAYS present with every key, zeroed on
+        # nodes without a mounted mempool (the PR-9 schema rule);
+        # with Config.mempool_capacity > 0 the owning node's provider
+        # overwrites with the admission pipeline's tallies
+        ingress: Dict[str, object] = {
+            "submitted": 0,
+            "admitted": 0,
+            "rejected": 0,
+            "retried": 0,
+            "deduped": 0,
+            "evicted": 0,
+            "subscribers": 0,
+            "mempool_depth": 0,
+        }
+        if self._ingress is not None:
+            ingress.update(self._ingress())
+        out["ingress"] = ingress
         if self._transport_health is not None:
             out["transport_health"] = self._transport_health()
         if self._trace_stats is not None:

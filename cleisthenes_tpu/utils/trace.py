@@ -77,6 +77,11 @@ CATEGORIES = frozenset(
         # reshare (discovery -> qualified set -> finalize) plus
         # discovered/deal/staged/install/activate/teardown instants
         # — the roster-switch timeline tools/tracetool.py reports
+        "ingress",  # client admission pipeline (transport/ingress +
+        # core/mempool): submit spans per ingress frame, admit/evict
+        # instants with the verdict, and one "stream" span per
+        # subscriber batch delivery — the client-visible latency
+        # timeline the ingress_load bench section measures against
     )
 )
 

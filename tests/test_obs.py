@@ -266,6 +266,20 @@ def _golden_target() -> ObsTarget:
             "virtual_time_ms": 1500,
         }
     )
+    # client ingress-plane counters (ISSUE 18): zeroed keys on every
+    # path; pinned nonzero so the golden scrape covers the families
+    m.set_ingress(
+        lambda: {
+            "submitted": 9,
+            "admitted": 6,
+            "rejected": 1,
+            "retried": 1,
+            "deduped": 1,
+            "evicted": 1,
+            "subscribers": 2,
+            "mempool_depth": 4,
+        }
+    )
     m.set_transport_health(
         lambda: {
             'peer"q\\s': {

@@ -103,6 +103,7 @@ def sample_schedule(
     pipeline_depth: Optional[int] = None,
     wan: bool = False,
     wan_profile: Optional[str] = None,
+    ingress: bool = False,
 ) -> dict:
     """One composite fault schedule, a pure function of ``seed``.
 
@@ -127,7 +128,16 @@ def sample_schedule(
     profile on the channel scheduler — drawn from the seed AFTER
     every other key (the same append-LAST rule as depth, so the WAN
     band's schedules extend the historical stream), or pinned with
-    ``wan_profile``."""
+    ``wan_profile``.
+
+    ``ingress=True`` (the client-ingress band, ISSUE 18) routes every
+    submitted tx through the in-proc twin of the client gRPC surface
+    (SimulatedCluster.ingress -> IngressPlane -> fee-priority
+    mempool) instead of add_transaction, with the admission schedule
+    — pool capacity, per-client cap, client population, duplicate
+    resubmit mix — drawn from the seed LAST of all (after the WAN
+    key, the same append-LAST rule), so every older band's seed
+    stream stays bit-identical."""
     rng = random.Random(seed)
     f = (n - 1) // 3
     ids = [f"node{i:03d}" for i in range(n)]
@@ -203,6 +213,24 @@ def sample_schedule(
         from cleisthenes_tpu.transport.wan import wan_profile_names
 
         wan_profile = rng.choice(wan_profile_names())
+    ingress_cfg: Optional[dict] = None
+    if ingress:
+        # client-ingress admission schedule (ISSUE 18): drawn LAST —
+        # the newest appended key, after the WAN draw — so non-ingress
+        # replays of historical seeds are untouched and an ingress
+        # schedule shares every other draw with its non-ingress twin.
+        # capacity below the per-admitter share of txs (txs spread
+        # round-robin over the honest nodes) forces priority eviction
+        # / RETRY_AFTER on some seeds; client_cap 2 trips per-client
+        # backpressure; the dup fraction exercises the ingress-side
+        # seen-ring dedup
+        ingress_cfg = {
+            "capacity": rng.choice((2, 3, 6, 16)),
+            "client_cap": rng.choice((2, 4, 64)),
+            "clients": rng.choice((3, 5, 8)),
+            "dup_fraction": round(rng.uniform(0.0, 0.4), 3),
+            "client_seed": rng.randrange(1 << 16),
+        }
 
     out = {
         "version": SCHEDULE_VERSION,
@@ -222,6 +250,8 @@ def sample_schedule(
     }
     if wan_profile is not None:
         out["wan_profile"] = wan_profile
+    if ingress_cfg is not None:
+        out["ingress"] = ingress_cfg
     return out
 
 
@@ -245,6 +275,11 @@ def _build_cluster(schedule: dict, trace: bool) -> SimulatedCluster:
     # the lead must clear depth + the DEFAULT lag the cluster runs
     # under (read off the dataclass, never a re-stated literal)
     lag = Config.__dataclass_fields__["decrypt_lag_max"].default
+    # client-ingress band (ISSUE 18): the schedule mounts the
+    # fee-priority mempool at its sampled capacity; absent on
+    # historical schedules (capacity 0 keeps the direct
+    # add_transaction path)
+    ing = schedule.get("ingress")
     cfg = Config(
         n=schedule["n"],
         batch_size=schedule["batch_size"],
@@ -261,6 +296,10 @@ def _build_cluster(schedule: dict, trace: bool) -> SimulatedCluster:
         # violate Config's lead > depth + decrypt_lag_max bound
         pipeline_depth=depth,
         reconfig_lead=max(8, depth + lag + 1),
+        mempool_capacity=(0 if ing is None else int(ing["capacity"])),
+        mempool_client_cap=(
+            64 if ing is None else int(ing["client_cap"])
+        ),
     )
     cluster = SimulatedCluster(
         n=schedule["n"],
@@ -422,6 +461,127 @@ def _check_safety(cluster, honest: List[str], submitted: set, rnd: int):
             )
 
 
+def _ingress_submit(
+    cluster,
+    honest: List[str],
+    schedule: dict,
+    submitted: set,
+    ok_acked: Dict[bytes, str],
+) -> None:
+    """Drive the schedule's client band through the in-proc ingress
+    twins (ISSUE 18): every tx submits as an encoded client frame via
+    SimulatedCluster.ingress() — the production admission path — with
+    client identity, fee bid and duplicate resubmits drawn from the
+    schedule's ``client_seed``.  Fills ``submitted`` (every tx, for
+    no_foreign_tx) and ``ok_acked`` (tx -> admitting node, for the
+    settle-exactly-once audit).  Raises Violation on an
+    admission-contract breach at submit time: an unknown ack status,
+    or a resubmit of an OK-acked tx that does not ack DUPLICATE."""
+    from cleisthenes_tpu.transport.message import IngressStatus
+
+    ing = schedule["ingress"]
+    irng = random.Random(ing["client_seed"])
+    clients = [f"fzclient{c:02d}" for c in range(ing["clients"])]
+    gates = {h: cluster.ingress(h) for h in honest}
+    for i in range(schedule["txs"]):
+        tx = b"fuzz-%06d" % i
+        h = honest[i % len(honest)]
+        client = irng.choice(clients)
+        fee = irng.randrange(1, 1_000)
+        # the dup decision draws BEFORE the ack is known, so the rng
+        # stream's shape never depends on mempool admission outcomes
+        want_dup = irng.random() < ing["dup_fraction"]
+        ack = gates[h].submit(client, i, fee, tx)
+        submitted.add(tx)
+        status = IngressStatus(ack.status)
+        if status is IngressStatus.OK:
+            ok_acked[tx] = h
+        elif status is not IngressStatus.RETRY_AFTER:
+            # fresh unique well-formed txs may only ack OK (admitted)
+            # or RETRY_AFTER (per-client/global pressure); DUPLICATE
+            # or REJECTED here is an admission-contract breach
+            raise Violation(
+                "ingress_ack",
+                f"fresh tx {tx!r} acked {status.name} on {h}",
+                0,
+            )
+        if want_dup and status is IngressStatus.OK:
+            dup = gates[h].submit(client, i, fee, tx)
+            if IngressStatus(dup.status) is not IngressStatus.DUPLICATE:
+                raise Violation(
+                    "ingress_dedup",
+                    f"resubmit of OK-acked tx {tx!r} acked "
+                    f"{IngressStatus(dup.status).name}, want DUPLICATE",
+                    0,
+                )
+
+
+def _ingress_audit(
+    cluster,
+    honest: List[str],
+    ok_acked: Dict[bytes, str],
+    rounds_used: int,
+) -> Optional[dict]:
+    """The band's terminal invariant (ISSUE 18): every acked-and-
+    unevicted tx settles EXACTLY once.  Concretely, on the reference
+    honest ledger (agreement already holds, so any honest node is
+    every honest node): no tx settles twice (the settle-time dedup
+    layer), and the OK-acked txs missing from the ledger are exactly
+    accounted by the honest mempools' eviction counters — an OK ack
+    is a promise: settle, or evict VISIBLY.  A tx stranded pending
+    (liveness hole) is unsettled-but-unevicted and fails the same
+    equation, so the standard liveness tail is subsumed.  Finally a
+    subscribe(0) replay on the reference node must stream the settled
+    epochs gap- and duplicate-free."""
+    nodes = cluster.nodes
+    ref = nodes[honest[0]]
+    counts: Dict[bytes, int] = {}
+    for batch in ref.committed_batches:
+        for tx in batch.tx_list():
+            counts[tx] = counts.get(tx, 0) + 1
+    for tx, c in counts.items():
+        if c > 1:
+            return {
+                "invariant": "ingress_exact_once",
+                "detail": f"tx {tx!r} settled {c} times",
+                "round": rounds_used,
+            }
+    lost = sorted(tx for tx in ok_acked if tx not in counts)
+    evicted = sum(
+        nodes[h].mempool.stats()["evicted"]
+        for h in honest
+        if nodes[h].mempool is not None
+    )
+    if len(lost) != evicted:
+        return {
+            "invariant": "ingress_exact_once",
+            "detail": (
+                f"{len(lost)} OK-acked txs unsettled vs {evicted} "
+                f"visible evictions"
+            ),
+            "round": rounds_used,
+        }
+    gate = cluster.ingress(honest[0])
+    feed = gate.subscribe(0)
+    got: List[int] = []
+    while True:
+        batch = gate.next_batch(feed, timeout=0.05)
+        if batch is None:
+            break
+        got.append(batch.epoch)
+    feed.close()
+    if got != list(range(len(ref.committed_batches))):
+        return {
+            "invariant": "ingress_replay",
+            "detail": (
+                f"subscribe(0) streamed epochs {got}, want "
+                f"0..{len(ref.committed_batches) - 1} contiguous"
+            ),
+            "round": rounds_used,
+        }
+    return None
+
+
 def run_schedule(
     schedule: dict, trace_path: Optional[str] = None
 ) -> Optional[dict]:
@@ -431,11 +591,14 @@ def run_schedule(
     cluster = _build_cluster(schedule, trace=trace_path is not None)
     bad = set(schedule["bad"])
     honest = [nid for nid in cluster.ids if nid not in bad]
+    ing = schedule.get("ingress")
     submitted: set = set()
-    for i in range(schedule["txs"]):
-        tx = b"fuzz-%06d" % i
-        cluster.nodes[honest[i % len(honest)]].add_transaction(tx)
-        submitted.add(tx)
+    ok_acked: Dict[bytes, str] = {}
+    if ing is None:
+        for i in range(schedule["txs"]):
+            tx = b"fuzz-%06d" % i
+            cluster.nodes[honest[i % len(honest)]].add_transaction(tx)
+            submitted.add(tx)
 
     by_round: Dict[int, List[dict]] = {}
     for ev in schedule["timeline"]:
@@ -454,6 +617,12 @@ def run_schedule(
     violation: Optional[dict] = None
     rounds_used = schedule["rounds"]
     try:
+        if ing is not None:
+            # client-ingress band: submission IS part of the schedule
+            # under test (ack-contract violations shrink like any
+            # other), so it runs inside the violation scope
+            _ingress_submit(cluster, honest, schedule, submitted,
+                            ok_acked)
         rounds_used = run_until_drained(
             cluster.net,
             cluster.nodes,
@@ -464,7 +633,18 @@ def run_schedule(
         )
     except Violation as v:
         violation = v.report
-    if violation is None and schedule.get("check_liveness", True):
+    if violation is None and ing is not None:
+        # the band's terminal check replaces the standard liveness
+        # tail: settle-exactly-once subsumes it (a stranded pending tx
+        # is unsettled-but-unevicted and fails the accounting)
+        final = [
+            nid
+            for nid in sorted(cluster.nodes)
+            if nid not in bad and not cluster.nodes[nid]._retired_self
+        ]
+        violation = _ingress_audit(cluster, final, ok_acked,
+                                   rounds_used)
+    elif violation is None and schedule.get("check_liveness", True):
         # liveness spans the roster change: every honest node that is
         # (still) a member at the end — original members AND joiners —
         # must hold every submitted tx.  A retired honest node stops
@@ -595,6 +775,7 @@ def fuzz_seeds(
     pipeline_depth: Optional[int] = None,
     wan: bool = False,
     wan_profile: Optional[str] = None,
+    ingress: bool = False,
 ) -> int:
     """Run a schedule per seed; on the first violation, shrink it and
     emit a repro file plus (by default) a flight-recorder trace
@@ -611,6 +792,7 @@ def fuzz_seeds(
             pipeline_depth=pipeline_depth,
             wan=wan,
             wan_profile=wan_profile,
+            ingress=ingress,
         )
         violation = run_schedule(schedule)
         if violation is None:
@@ -667,6 +849,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         "the seed (implies --wan)",
     )
     ap.add_argument(
+        "--ingress",
+        action="store_true",
+        help="client-ingress band (ISSUE 18): submit every tx "
+        "through the in-proc ingress twin + fee-priority mempool "
+        "with a seeded client/fee/dup schedule, appended LAST so "
+        "historical seed streams extend; gates the "
+        "settle-exactly-once invariant",
+    )
+    ap.add_argument(
         "--show", action="store_true", help="print the schedule, no run"
     )
     ap.add_argument("--repro", help="replay a repro file")
@@ -708,6 +899,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 pipeline_depth=args.pipeline_depth,
                 wan=wan,
                 wan_profile=args.wan_profile,
+                ingress=args.ingress,
             )
             json.dump(schedule, sys.stdout, indent=2, sort_keys=True)
             print()
@@ -722,6 +914,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         pipeline_depth=args.pipeline_depth,
         wan=wan,
         wan_profile=args.wan_profile,
+        ingress=args.ingress,
     )
 
 

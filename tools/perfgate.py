@@ -67,6 +67,13 @@ MINI_EPOCHS = 3
 MINI_SEED = 1999
 
 DEFAULT_WINDOW = 20
+# ingress mini-load shape (ISSUE 18): small enough for a CI stage,
+# big enough that submit->ordered p50 moves when the admission path
+# or the drain seam regresses
+INGRESS_CLIENTS = 400
+INGRESS_TXS = 400
+INGRESS_TICKS = 6
+INGRESS_BATCH = 64
 DEFAULT_REL_TOL = 1.0  # fresh p50 may double before failing (CI noise)
 DEFAULT_ABS_TOL_MS = 50.0
 DEFAULT_SHARE_TOL = 0.25
@@ -295,6 +302,16 @@ def run_sample(
             # them what every per-epoch dispatch counter MEANS — so
             # runs gate only against same-depth trend records
             "pipeline_depth": int(cfg.pipeline_depth),
+            # the ingress mini-load's shape changes what the
+            # submit->ordered p50 and the eviction count MEAN —
+            # reshaping it re-keys the trend (run --reset after an
+            # intentional change)
+            "ingress": {
+                "clients": INGRESS_CLIENTS,
+                "txs": INGRESS_TXS,
+                "ticks": INGRESS_TICKS,
+                "batch": INGRESS_BATCH,
+            },
         },
         "epoch_p50_ms": round(p50 * 1000.0, 3),
         "epoch_p95_ms": round(p95 * 1000.0, 3),
@@ -360,6 +377,36 @@ def run_sample(
         "coin_dispatches": int(
             cluster.nodes[ids[0]].hub.stats()["coin_issue_batches"]
         ),
+        # ingress plane (ISSUE 18): a seeded mini load through the
+        # production admission path (tools/loadgen.py arm — in-proc
+        # twin of the client gRPC surface + fee-priority mempool).
+        # submit_to_ordered_p50_ms is the client-visible protocol-
+        # plane latency (wall clock: gated with the same noise band
+        # as the epoch p50); mempool_evictions is DETERMINISTIC for
+        # the seeded schedule and must stay zero — the mini load is
+        # sized to fit the pool, so any eviction is an admission-
+        # policy regression, not pressure
+        **_ingress_sample(seed),
+    }
+
+
+def _ingress_sample(seed: int) -> Dict:
+    """The ingress mini-load: one seconds-scale loadgen arm over the
+    shared production path (shape below is part of the fingerprint —
+    changing it re-keys the trend, see --reset)."""
+    from tools import loadgen
+
+    sched = loadgen.build_schedule(
+        clients=INGRESS_CLIENTS, txs=INGRESS_TXS, ticks=INGRESS_TICKS,
+        seed=seed,
+    )
+    arm = loadgen.run_arm(
+        sched, depth=2, n=MINI_N, batch=INGRESS_BATCH, seed=seed
+    )
+    return {
+        "submit_to_ordered_p50_ms": arm["submit_to_ordered_ms"]["p50"],
+        "submit_to_settled_p50_ms": arm["submit_to_settled_ms"]["p50"],
+        "mempool_evictions": int(arm["evicted"]),
     }
 
 
@@ -407,6 +454,25 @@ def compare(
                 f"noise-band limit {limit:.3f} ms "
                 f"(trend median {med:.3f} ms over {len(p50s)} runs)"
             )
+    # client-visible ingress latency (ISSUE 18): submit->ordered p50
+    # through the production admission path, same noise band as the
+    # epoch p50 above (wall-clock: the relative band absorbs CI-host
+    # noise, the absolute floor keeps mini-load jitter honest)
+    ing_p50s = [
+        r["submit_to_ordered_p50_ms"]
+        for r in trend
+        if isinstance(r.get("submit_to_ordered_p50_ms"), (int, float))
+    ]
+    fresh_ing = fresh.get("submit_to_ordered_p50_ms")
+    if ing_p50s and isinstance(fresh_ing, (int, float)):
+        med = statistics.median(ing_p50s)
+        limit = max(med * (1.0 + rel_tol), med + abs_tol_ms)
+        if fresh_ing > limit:
+            reasons.append(
+                f"submit_to_ordered_p50_ms regression: "
+                f"{fresh_ing:.3f} ms > noise-band limit {limit:.3f} ms "
+                f"(trend median {med:.3f} ms over {len(ing_p50s)} runs)"
+            )
     # deterministic-counter gates: hub dispatches (PR 7) and the
     # delivery-plane frame/MAC counters (ISSUE 9) share one rule —
     # the seeded schedule makes them exact, so exceeding the trend
@@ -419,6 +485,10 @@ def compare(
         ("frames_encoded", "frame-encode"),
         ("mac_signs", "MAC-sign"),
         ("coin_dispatches", "coin-dispatch"),
+        # the seeded ingress mini-load fits its pool by construction,
+        # so the eviction count is deterministic (zero on a healthy
+        # run): any fresh eviction is an admission-policy regression
+        ("mempool_evictions", "mempool-eviction"),
     ):
         history = [
             r[counter] for r in trend if isinstance(r.get(counter), int)
