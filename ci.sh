@@ -176,6 +176,15 @@ JAX_PLATFORMS=cpu python -m tools.fuzz --seeds 0:20 --wan \
 # settled epochs gap-free
 JAX_PLATFORMS=cpu python -m tools.fuzz --seeds 0:20 --ingress \
     --out "$FUZZ_OUT"
+# attested reduced-quorum band (ISSUE 19): n = 2f+1 rosters under the
+# simulated-TEE trust model — attested_log + reduced_quorum armed,
+# equivocator-biased adversaries — gating the attestation invariants
+# on top of the classic ones: no honest node is ever accused, every
+# equivocation the vault refused shows up in the directory's accused
+# set, and the honest ledgers stay byte-identical at n - f quorums
+# (appended LAST, extending the historical stream)
+JAX_PLATFORMS=cpu python -m tools.fuzz --seeds 0:20 \
+    --reduced-quorum --out "$FUZZ_OUT"
 rm -rf "$FUZZ_OUT"
 
 if [[ "${CI_FAST:-0}" == "1" ]]; then

@@ -34,6 +34,8 @@ ARM_FLAGS = (
     "delivery_columnar",
     "wave_routing",
     "egress_columnar",
+    "attested_log",
+    "reduced_quorum",
 )
 
 DEFAULT_DIAL_TIMEOUT_S = 3.0  # reference comm.go:107-109
@@ -304,15 +306,58 @@ class Config:
     # TCP port for the client-facing gRPC ingress service (None =
     # no listener; the in-process twin is always available).
     ingress_port: Optional[int] = None
+    # --- attested trust model (protocol/attest.py) ----------------
+    # attested_log mounts the simulated-TEE attestation plane: every
+    # outbound frame carries a MAC'd (incarnation, counter) attestation
+    # issued by a per-node AttestationVault that REFUSES to attest two
+    # different digests for the same protocol slot — so an equivocating
+    # sender is forced to ship counter-fork evidence (a refused=1
+    # trailer); honest receivers record the accusation and reject the
+    # lied frames themselves, so equivocation degrades to omission of
+    # exactly the forked statements while the sender's honest traffic
+    # keeps feeding the quorums (load-bearing at n = 2f+1).  The
+    # vault sits BELOW the protocol plane's Behavior seam
+    # (protocol.byzantine): a semantic adversary can rewrite payloads
+    # but cannot forge, fork or suppress attestations.  False is the
+    # baseline arm: no trailers, no per-link counter state, frames
+    # byte-identical to the pre-attestation wire format.
+    attested_log: bool = False
+    # reduced_quorum switches the large-quorum arithmetic (the 2f+1
+    # READY/deliver/bin_values/TERM-halt thresholds) to n-f, the
+    # TEE-reduced form of arxiv 2102.01970: with equivocation excluded
+    # by the attested log, any two (n-f)-quorums of an n >= 2f+1
+    # roster intersect in a non-equivocating node and safety holds at
+    # rosters a third smaller.  f defaults to floor((n-1)/2) in this
+    # mode and Config enforces n >= 2f+1 instead of 3f+1.  At the
+    # baseline roster shape n = 3f+1 exactly, n-f == 2f+1, so the
+    # False arm's arithmetic is bit-identical to the historical
+    # thresholds.  Sound only together with attested_log (enforced).
+    reduced_quorum: bool = False
 
     def __post_init__(self) -> None:
         if self.n < 1:
             raise ValueError(f"n={self.n} must be >= 1")
+        if self.reduced_quorum and not self.attested_log:
+            raise ValueError(
+                "reduced_quorum=True requires attested_log=True: the "
+                "n-f quorum intersection argument only holds once "
+                "equivocation is excluded by the attested sender log"
+            )
         if self.f is None:
-            self.f = (self.n - 1) // 3
+            self.f = (
+                (self.n - 1) // 2
+                if self.reduced_quorum
+                else (self.n - 1) // 3
+            )
         if self.f < 0:
             raise ValueError(f"f={self.f} must be >= 0")
-        if self.n < 3 * self.f + 1:
+        if self.reduced_quorum:
+            if self.n < 2 * self.f + 1:
+                raise ValueError(
+                    f"n={self.n} must be >= 2f+1={2 * self.f + 1} "
+                    "in reduced-quorum mode (arxiv 2102.01970)"
+                )
+        elif self.n < 3 * self.f + 1:
             raise ValueError(
                 f"n={self.n} must be >= 3f+1={3 * self.f + 1} "
                 "(docs/BBA-EN.md:26: t < n/3)"
@@ -428,3 +473,13 @@ class Config:
         """f+1 decryption shares recover a TPKE plaintext
         (docs/HONEYBADGER-EN.md:40-42, docs/THRESHOLD_ENCRYPTION-EN.md:33-36)."""
         return self.f + 1
+
+    @property
+    def quorum_large(self) -> int:
+        """The large-quorum threshold: READY amplification to deliver,
+        BVAL bin_values growth, TERM halt.  Baseline 2f+1; in
+        reduced-quorum mode n-f (identical when n = 3f+1 exactly, so
+        every historical roster's arithmetic is unchanged).  The f+1
+        relay thresholds and the n-f input-wait thresholds are mode-
+        independent."""
+        return (self.n - self.f) if self.reduced_quorum else (2 * self.f + 1)

@@ -88,7 +88,19 @@ class RosterVersion:
 
     @property
     def f(self) -> int:
+        """Maximum fault budget under the BASELINE (3f+1) trust model.
+        Quorum-mode-aware consumers use ``fault_budget``."""
         return (len(self.members) - 1) // 3
+
+    def fault_budget(self, reduced_quorum: bool = False) -> int:
+        """Maximum tolerable f for this roster size under the given
+        trust model: floor((n-1)/3) baseline, floor((n-1)/2) when the
+        attested sender log enables the reduced (2f+1) quorum mode —
+        the seam through which roster views carry the quorum mode
+        (Config.reduced_quorum re-derives per-version f through the
+        same arithmetic in HoneyBadger.install_roster_version)."""
+        d = 2 if reduced_quorum else 3
+        return (len(self.members) - 1) // d
 
 
 class RosterSchedule:

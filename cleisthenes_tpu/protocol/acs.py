@@ -238,13 +238,19 @@ class ACS:
         # (protocol.votebank)
         from cleisthenes_tpu.protocol.votebank import VoteBank
 
-        self.bank = VoteBank(self.members, config.f, metrics=metrics)
+        self.bank = VoteBank(
+            self.members, config.f, metrics=metrics,
+            quorum_large=config.quorum_large,
+        )
         # the RBC twin of the vote bank: ECHO/READY receipt state for
         # every instance as struct-of-arrays (protocol.echobank), so
         # columnar echo/ready waves update vectorized too
         from cleisthenes_tpu.protocol.echobank import EchoBank
 
-        self.echo_bank = EchoBank(self.members, config.f, metrics=metrics)
+        self.echo_bank = EchoBank(
+            self.members, config.f, metrics=metrics,
+            quorum_large=config.quorum_large,
+        )
         self.rbcs: Dict[str, RBC] = {}
         self.bbas: Dict[str, BBA] = {}
         for index, proposer in enumerate(self.members):

@@ -116,6 +116,9 @@ class BBA:
     ) -> None:
         self.n = config.n
         self.f = config.f
+        # bin_values / TERM-halt threshold: 2f+1 baseline, n-f
+        # under Config.reduced_quorum (Config.quorum_large)
+        self.q_large = config.quorum_large
         self.epoch = epoch
         self.proposer = proposer
         self.owner = owner
@@ -126,7 +129,7 @@ class BBA:
 
             bank = VoteBank(
                 self.members, config.f, inst_ids=[proposer],
-                metrics=metrics,
+                metrics=metrics, quorum_large=config.quorum_large,
             )
             index = 0
         self.bank = bank
@@ -324,8 +327,8 @@ class BBA:
         # sentBvalSet of bba/bba.go:48)
         if cnt >= self.f + 1:
             self.on_bval_relay(value)
-        # 2f+1 -> bin_values union (docs/BBA-EN.md:53-58)
-        if cnt >= 2 * self.f + 1:
+        # q_large -> bin_values union (docs/BBA-EN.md:53-58)
+        if cnt >= self.q_large:
             self.on_bval_bin(value)
 
     def on_bval_relay(self, value: bool) -> None:
@@ -681,7 +684,7 @@ class BBA:
         n_votes = len(self._term_recv[value])
         if n_votes >= self.f + 1 and self.decided is None:
             self._decide(value)  # adopt: f+1 guarantees a correct voter
-        if n_votes >= 2 * self.f + 1:
+        if n_votes >= self.q_large:
             # enough correct nodes have decided and broadcast TERM that
             # every correct node will adopt+halt without our help
             self.halted = True

@@ -33,6 +33,10 @@ from typing import Callable, Dict, List, Optional, Sequence
 from cleisthenes_tpu.config import Config
 from cleisthenes_tpu.core.batch import Batch
 from cleisthenes_tpu.ops.backend import get_backend
+from cleisthenes_tpu.protocol.attest import (
+    AttestationDirectory,
+    AttestingAuthenticator,
+)
 from cleisthenes_tpu.protocol.honeybadger import HoneyBadger, setup_keys
 from cleisthenes_tpu.protocol.hub import CryptoHub
 from cleisthenes_tpu.transport.base import HmacAuthenticator
@@ -165,6 +169,17 @@ class SimulatedCluster:
         # authenticators are kept per node: dynamic membership
         # installs joiner pair keys / drops retirees through them
         self.auths: Dict[str, HmacAuthenticator] = {}
+        # attested sender log (Config.attested_log): the cluster holds
+        # the directory — the in-proc stand-in for each node's sealed
+        # TEE NVRAM.  Vault state (counters, slots) survives
+        # restart_node() with an incarnation bump, exactly the
+        # monotonicity a real attested counter must keep across
+        # process restarts; fork evidence aggregates here too.
+        self.attest_dir = (
+            AttestationDirectory()
+            if self.config.attested_log
+            else None
+        )
         # optional per-node durable WALs (crash/restart tests):
         # wal_dir/<node>.log, restored by restart_node()
         self._wal_dir = wal_dir
@@ -173,7 +188,7 @@ class SimulatedCluster:
         # replay re-derives any roster versions it lived through)
         self._node_params: Dict[str, dict] = {}
         for nid in self.ids:
-            auth = HmacAuthenticator(nid, self.keys[nid].mac_keys)
+            auth = self._make_auth(nid, self.keys[nid].mac_keys)
             self.auths[nid] = auth
             self._node_params[nid] = {
                 "config": self.config,
@@ -337,6 +352,19 @@ class SimulatedCluster:
             assert len(lists) == 1, f"fork at epoch {e}"
         return depth
 
+    def _make_auth(self, nid: str, mac_keys) -> HmacAuthenticator:
+        """Build one node's authenticator: plain pairwise-MAC, or —
+        under Config.attested_log — the attesting subclass bound to
+        the node's vault in the cluster-held directory.  attach()
+        bumps the vault incarnation, so a restarted node resumes its
+        sender log monotonically instead of re-using sequence
+        numbers."""
+        if self.attest_dir is None:
+            return HmacAuthenticator(nid, mac_keys)
+        return AttestingAuthenticator(
+            nid, mac_keys, self.attest_dir.attach(nid)
+        )
+
     def _make_wal(self, nid: str):
         if self._wal_dir is None:
             return None
@@ -364,7 +392,7 @@ class SimulatedCluster:
         if stale_plane is not None:
             stale_plane.close()
         params = self._node_params[nid]
-        auth = HmacAuthenticator(nid, self.keys[nid].mac_keys)
+        auth = self._make_auth(nid, self.keys[nid].mac_keys)
         self.auths[nid] = auth
         hb = HoneyBadger(
             config=params["config"],
@@ -540,7 +568,7 @@ class SimulatedCluster:
             enroll_secret=secret,
         )
         jcfg = _dc.replace(self.config, n=len(current_ids), f=None)
-        auth = HmacAuthenticator(jid, mac_keys)
+        auth = self._make_auth(jid, mac_keys)
         self._node_params[jid] = {
             "config": jcfg,
             "member_ids": list(current_ids),

@@ -78,9 +78,14 @@ class EchoBank:
         f: int,
         inst_ids: Optional[Sequence[str]] = None,
         metrics=None,
+        quorum_large: Optional[int] = None,
     ) -> None:
         self.members: List[str] = sorted(member_ids)
         self.f = f
+        # the READY deliver threshold: 2f+1 in the baseline trust
+        # model, n-f under Config.reduced_quorum (identical whenever
+        # n = 3f+1 exactly — see Config.quorum_large)
+        self.q_large = 2 * f + 1 if quorum_large is None else quorum_large
         # owner-node metrics (None in standalone unit tests): only the
         # duplicate-vote absorption counter is touched here
         self.metrics = metrics
@@ -289,9 +294,9 @@ class EchoBank:
                 and rbc._ready_root is None
             ):
                 rbc._send_ready(roots[pos[k]])
-        # 2f+1 reached: deliver probe (>= — post-crossing READYs
+        # q_large reached: deliver probe (>= — post-crossing READYs
         # re-probe a decode that completed since, like the scalar path)
-        for k in np.nonzero(after >= 2 * f + 1)[0]:
+        for k in np.nonzero(after >= self.q_large)[0]:
             rbc = rbcs[pi[k]]
             if rbc is not None and not rbc.delivered:
                 rbc._maybe_deliver(roots[pos[k]])

@@ -106,6 +106,24 @@ CATCHUP_REPEAT_BUDGET = 2
 # after every this-many further sightings of far-ahead traffic — a
 # deterministic, traffic-driven retry (no timers in the protocol plane)
 CATCHUP_RENUDGE_EVERY = 32
+# reduced-quorum stall watchdog (Config.reduced_quorum only): forced
+# catch-up chases per stuck settled frontier, fired at quiet idle
+# boundaries (no inbound since the previous idle callback while
+# settled < live frontier), re-armed whenever settlement advances.
+# At n-f quorums the READY amplification threshold (f+1) EQUALS the
+# delivery quorum, so Bracha totality no longer follows from honest
+# traffic alone: a node that missed a lossy coalition member's frames
+# can sit one READY short of an instance the rest of the roster
+# delivered, wedging its ACS forever in an otherwise quiescent
+# cluster.  The repair is retrieval, not lower thresholds (lowering
+# amplification below f+1 would let an attested-but-lying coalition
+# lock honest READYs onto a fabricated root): chase the committed
+# batches through CATCHUP, whose f+1 byte-identical adoption rule is
+# loss-tolerant under retry.  Counted, not clocked — seeded runs
+# replay exactly.  Baseline (3f+1) arms never fire this: totality
+# holds from honest traffic alone, and gating on the flag keeps every
+# historical schedule byte-identical.
+CATCHUP_STALL_BUDGET = 4
 
 MAX_TXS_PER_LIST = 1_000_000
 
@@ -718,6 +736,14 @@ class HoneyBadger:
         self._catchup_ord_tallies: Dict[int, Dict[str, bytes]] = {}
         self._last_catchup_request: Optional[int] = None
         self._farahead_sightings = 0
+        # reduced-quorum stall watchdog state: inbound-ingest tick
+        # (any serve_wave/serve_request call), the tick value seen at
+        # the previous idle callback, and the per-stuck-frontier
+        # forced-chase budget (CATCHUP_STALL_BUDGET)
+        self._idle_rx = 0
+        self._idle_rx_seen = -1
+        self._stall_frontier = -1
+        self._stall_nudges = 0
         # serving-side guard state (all counted, never clocked):
         # sender -> end of the last window served (its next request
         # must reach it to be served unconditionally); sender ->
@@ -1111,6 +1137,12 @@ class HoneyBadger:
         if self._authenticator is not None:
             for peer in sorted(pair_keys):
                 self._authenticator.set_peer_key(peer, pair_keys[peer])
+            # MAC rotation step 1: stage the surviving pairs' fresh
+            # version keys (inbound verifies under either key from
+            # here; signing switches at the activation boundary)
+            staged = self._reconfig.rotation_pair_keys(spec)
+            for peer in sorted(staged):
+                self._authenticator.stage_peer_key(peer, staged[peer])
         old_ids = set(self.active_view.member_ids)
         if self.node_id not in old_ids:
             return  # a joiner widens nothing: it adopts, then activates
@@ -1254,6 +1286,14 @@ class HoneyBadger:
             self.keys = view.keys
             self.tpke = view.tpke
             self.coin = view.coin
+            if self._authenticator is not None:
+                # MAC rotation step 2: signing switches to the staged
+                # version key for every surviving pair (no-op for a
+                # joiner, and for pairs with nothing staged — e.g.
+                # when a catch-up adopter's teardown already pinned
+                # the fresh keys)
+                for peer in view.member_ids:
+                    self._authenticator.promote_staged_key(peer)
             self.b = max(self.config.batch_size, view.config.n)
             # fan out to old ∪ new until the settled frontier crosses
             # the boundary (teardown narrows to the new roster): the
@@ -1298,6 +1338,19 @@ class HoneyBadger:
                 self._authenticator.drop_peer(peer)
             if self.on_peer_retired is not None:
                 self.on_peer_retired(peer)
+        if self._authenticator is not None and view.keys is not None:
+            # MAC rotation step 3: pin every surviving pair to the
+            # version's fresh key (idempotent after the activation-
+            # time promote, and correct even when a catch-up
+            # adopter's settle crosses the boundary before its
+            # ordered frontier does) and drop the alternates — a
+            # frame MAC'd under a pre-rotation key is rejected from
+            # here on
+            for peer in view.member_ids:
+                self._authenticator.set_peer_key(
+                    peer, view.keys.mac_keys[peer]
+                )
+                self._authenticator.drop_alt_key(peer)
         if retired and self.trace is not None:
             self.trace.instant(
                 "reconfig",
@@ -1407,6 +1460,7 @@ class HoneyBadger:
         # eagerly staged dec shares (epochs ordered during this wave,
         # including inside run_deferred) piggyback on this flush
         self._drain_dec_issues()
+        self._maybe_chase_stall()
         self._coalesce.flush()
 
     def _exit_turn(self) -> None:
@@ -1512,6 +1566,7 @@ class HoneyBadger:
         per (message kind, wave) — the per-payload scalar chain below
         stays live as the byte-equivalence comparison arm."""
         try:
+            self._idle_rx += len(msgs)
             if self.trace is not None:
                 self._trace_wave_msgs += len(msgs)
             self._router.route(msgs)
@@ -1520,6 +1575,7 @@ class HoneyBadger:
 
     def serve_request(self, msg: Message) -> None:
         try:
+            self._idle_rx += 1
             if self.trace is not None:
                 self._trace_wave_msgs += 1
             payload = msg.payload
@@ -2225,6 +2281,51 @@ class HoneyBadger:
             self._request_catchup(force=True)
         finally:
             self._exit_turn()
+
+    def _maybe_chase_stall(self) -> None:
+        """Reduced-quorum stall watchdog (see CATCHUP_STALL_BUDGET).
+
+        Runs at every transport idle callback, right before the
+        outbound flush so a fired chase ships with this wave.  A
+        "quiet" idle — no serve_wave/serve_request ingest since the
+        previous idle callback — while epochs sit started-but-unsettled
+        is the signature of the n-f totality wedge: the roster went
+        quiescent around an instance this node is one attested READY
+        short of delivering (a lossy coalition sender's frame that
+        nobody will re-send).  Chasing the settled frontier through
+        CATCHUP retrieves the committed batches instead; the budget
+        (re-armed on every settle advance) bounds the extra traffic so
+        a genuinely unservable frontier — fewer than f+1 peers hold
+        the batch — still quiesces."""
+        if not self.config.reduced_quorum:
+            return
+        rx = self._idle_rx
+        quiet = rx == self._idle_rx_seen
+        self._idle_rx_seen = rx
+        settled = len(self.committed_batches)
+        # stuck = settled behind the live frontier, OR a live-frontier
+        # epoch whose ACS/settle never finished (a node wedged inside
+        # its very first epoch has settled == self.epoch == 0 — the
+        # frontier comparison alone would read as healthy)
+        stuck = settled < self.epoch or any(
+            not es.committed for es in self._epochs.values()
+        )
+        if not stuck:
+            self._stall_nudges = 0
+            return
+        if not quiet:
+            return
+        if settled != self._stall_frontier:
+            self._stall_frontier = settled
+            self._stall_nudges = 0
+        if self._stall_nudges >= CATCHUP_STALL_BUDGET:
+            return
+        self._stall_nudges += 1
+        if self.trace is not None:
+            self.trace.instant(
+                "catchup", "stall_chase", settled=settled, live=self.epoch
+            )
+        self._request_catchup(force=True)
 
     def _request_catchup(self, force: bool = False) -> None:
         # the SETTLED frontier is what we are missing durably; peers

@@ -80,6 +80,9 @@ class RBC:
     ) -> None:
         self.n = config.n
         self.f = config.f
+        # READY deliver threshold: 2f+1 baseline, n-f under
+        # Config.reduced_quorum (Config.quorum_large)
+        self.q_large = config.quorum_large
         self.k = config.data_shards
         self.epoch = epoch
         self.proposer = proposer
@@ -105,7 +108,8 @@ class RBC:
             from cleisthenes_tpu.protocol.echobank import EchoBank
 
             bank = EchoBank(
-                member_ids, config.f, inst_ids=[proposer], metrics=metrics
+                member_ids, config.f, inst_ids=[proposer], metrics=metrics,
+                quorum_large=config.quorum_large,
             )
             index = 0
         self.bank = bank
@@ -431,11 +435,11 @@ class RBC:
         self.hub.mark_dirty(self)
 
     def _maybe_deliver(self, root: bytes) -> None:
-        """2f+1 READY(h) + N-2f verified shards -> deliver
-        (docs/RBC-EN.md:41-42)."""
+        """q_large READY(h) + N-2f verified shards -> deliver
+        (docs/RBC-EN.md:41-42; q_large = 2f+1 baseline, n-f reduced)."""
         if self.delivered:
             return
-        if self.bank.ready_count(self.index, root) < 2 * self.f + 1:
+        if self.bank.ready_count(self.index, root) < self.q_large:
             return
         value = self._decoded.get(root)
         if value is None:

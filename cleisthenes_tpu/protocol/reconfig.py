@@ -50,30 +50,43 @@ is its coin share (secret x_i, public vk_i = g^{x_i} — already in the
 coin key's verification table); a joiner's is its enrollment keypair
 from the RECONFIG tx.  Any pair (a, b) of the new roster derives
 k_ab = H(version || g^{x_a x_b} || a || b) — both ends compute it
-locally, nothing secret crosses the wire.  Surviving old-old pairs
-keep their existing dealer-issued pair keys (rotating them mid-stream
-would invalidate in-flight frames for no security win — the pair set
-itself is what changed).
+locally, nothing secret crosses the wire.  EVERY pair of the new
+roster gets a fresh version-keyed MAC key — surviving pairs included:
+survivors STAGE the next key at discovery (inbound frames verify
+under either key), PROMOTE it to the signing key at the activation
+boundary, and DROP the old one at retirement teardown (the rotation
+half of ``transport.base.HmacAuthenticator``), so a pair key captured
+before a reconfig stops authenticating anything once the reconfig
+settles.
 
-Known limitation (documented in docs/FAULTS.md): a Byzantine dealer
-whose dealing passes the structural checks can still encrypt garbage
-to one targeted receiver.  The receiver detects it (share-vs-
-commitment verification) and fails loudly rather than diverging;
-public verifiability of the blobs (PVSS) is the known fix and is out
-of scope here, like signatures-vs-MACs.
+Reshare blobs are PUBLICLY verifiable (PVSS): each share is encrypted
+chunk-wise to the receiver's static-DH key (ElGamal in the exponent,
+16-bit chunks) with an aggregated Chaum-Pedersen DLEQ proof binding
+the ciphertext to the dealer's OWN Feldman commitments.  Every node —
+receiver of the blob or not — verifies every blob before admitting a
+dealing to the qualified set, so a dealer that encrypts garbage to
+one targeted receiver is excluded deterministically by ALL honest
+nodes at the same log position: no complaint round, no divergence,
+the ceremony completes from the remaining dealers.  Residual
+(documented in docs/FAULTS.md): the DLEQ binds the weighted SUM of
+the chunks, not each chunk's 16-bit range, so a malicious dealer can
+still emit non-canonical chunks that verify publicly but fail the
+receiver's table decode — the receiver fails loudly exactly as
+before, but the attack surface narrows from "any garbage bytes" to
+that single malformation.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import functools
 import hashlib
-import hmac as _hmac
 import struct
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from cleisthenes_tpu.core.member import Address, Member, RosterVersion
 from cleisthenes_tpu.ops.dkg import DkgDealing, validate_commitments
-from cleisthenes_tpu.ops.modmath import GroupParams
+from cleisthenes_tpu.ops.modmath import GroupParams, get_engine_degraded
 from cleisthenes_tpu.ops import tpke as tpke_mod
 from cleisthenes_tpu.ops.tpke import (
     ThresholdPublicKey,
@@ -84,7 +97,10 @@ from cleisthenes_tpu.ops.tpke import (
 # transactions out of any sane application tx namespace, and the
 # version digit hard-partitions future format changes.
 RECONFIG_TX_PREFIX = b"\x00RCFG1|"
-DEAL_TX_PREFIX = b"\x00RDEAL1|"
+# RDEAL1 -> RDEAL2: the share blobs became PVSS (chunked ElGamal +
+# DLEQ) — a different byte format, hard-partitioned by the version
+# digit exactly as the tag comment above promises.
+DEAL_TX_PREFIX = b"\x00RDEAL2|"
 
 # DoS bounds on decoded tables (mirrors transport.message's caps)
 MAX_ROSTER = 4096
@@ -354,60 +370,314 @@ def pair_mac_key(
     ).digest()
 
 
-def _share_key(
-    version: int, dealer: str, receiver: str, dh: int, group: GroupParams
-) -> bytes:
-    return hashlib.sha256(
-        b"rcfgshare|%d|" % version
-        + dh.to_bytes(group.nbytes, "big")
-        + b"|" + dealer.encode("utf-8")
-        + b"|" + receiver.encode("utf-8")
-    ).digest()
+# ---------------------------------------------------------------------------
+# PVSS share blobs: chunked ElGamal-in-the-exponent + aggregated DLEQ
+# ---------------------------------------------------------------------------
+#
+# A share s in Z_q splits into m big-endian 16-bit chunks s_k.  Each
+# chunk encrypts to the receiver's static-DH key y as an ElGamal pair
+# in the exponent: (A_k, E_k) = (g^{rho_k}, y^{rho_k} * g^{s_k}).
+# With weights w_k = 2^{16(m-1-k)}, the products Abar = prod A_k^{w_k}
+# and Ebar = prod E_k^{w_k} satisfy Abar = g^{rho}, Ebar = y^{rho} *
+# g^{s} for rho = sum rho_k w_k — so a single Chaum-Pedersen DLEQ
+# proof over (g, y) for the pair (Abar, Ebar / X_j), where X_j =
+# prod C_i^{j^i} is the share's Feldman image, PUBLICLY proves the
+# blob decrypts (under the receiver's secret) to the exact share the
+# dealer committed to — mod q, up to the non-canonical-chunk residual
+# the module docstring describes.  The receiver recovers each g^{s_k}
+# as E_k * A_k^{-x} and inverts it through a 2^16-entry table.
+
+PVSS_CHUNK_BITS = 16
+PVSS_CHUNK_BASE = 1 << PVSS_CHUNK_BITS
 
 
-def _keystream(key: bytes, n: int) -> bytes:
-    out = b""
-    ctr = 0
-    while len(out) < n:
-        out += hashlib.sha256(key + struct.pack(">I", ctr)).digest()
-        ctr += 1
-    return out[:n]
+def _pvss_chunk_count(group: GroupParams) -> int:
+    return -(-group.q.bit_length() // PVSS_CHUNK_BITS)
 
 
-def encrypt_share_pair(
-    key: bytes, s_tpke: int, s_coin: int, group: GroupParams
-) -> bytes:
-    """XOR-pad the fixed-width (tpke, coin) share pair under the
-    pair's DH-derived key + an HMAC tag (encrypt-then-MAC; the
-    receiver also verifies the decrypted shares against the dealer's
-    commitments, which is the binding check that actually matters)."""
+def pvss_blob_len(group: GroupParams) -> int:
+    """Exact byte length of one receiver's blob: two share sections
+    (tpke then coin), each m ciphertext pairs (A_k, E_k) of one group
+    element apiece plus the compact DLEQ proof (c: 32 bytes, z: one
+    scalar) — a pure function of the group, so malformed lengths
+    reject before any group math."""
     nb = group.nbytes
-    plain = s_tpke.to_bytes(nb, "big") + s_coin.to_bytes(nb, "big")
-    ct = bytes(
-        x ^ y for x, y in zip(plain, _keystream(key, len(plain)))
+    m = _pvss_chunk_count(group)
+    return 2 * (2 * m * nb + 32 + nb)
+
+
+@functools.lru_cache(maxsize=4)
+def _pvss_tables(group: GroupParams):
+    """(powers, dlog): g^v for v in [0, 2^16) and the inverse map —
+    the chunk codec.  Built once per group (~20 ms, ~4 MB for the
+    default 256-bit group)."""
+    size = min(PVSS_CHUNK_BASE, group.q)
+    powers: List[int] = [0] * size
+    dlog: Dict[int, int] = {}
+    acc = 1
+    for v in range(size):
+        powers[v] = acc
+        dlog[acc] = v
+        acc = acc * group.g % group.p
+    return powers, dlog
+
+
+@functools.lru_cache(maxsize=4)
+def _pvss_weights(group: GroupParams) -> Tuple[int, ...]:
+    m = _pvss_chunk_count(group)
+    return tuple(
+        pow(PVSS_CHUNK_BASE, m - 1 - k, group.q) for k in range(m)
     )
-    tag = _hmac.new(key, ct, hashlib.sha256).digest()
-    return ct + tag
 
 
-def decrypt_share_pair(
-    key: bytes, blob: bytes, group: GroupParams
-) -> Tuple[int, int]:
+def _pvss_engine(group: GroupParams):
+    """The batched-modexp engine for PVSS hot loops (the native cpu
+    kernel is ~8x builtin pow; the tpu path batches further)."""
+    return get_engine_degraded("cpu", None, group)
+
+
+def _jacobi(a: int, n: int) -> int:
+    """Jacobi symbol (a/n), n odd positive.  For the safe-prime groups
+    here (p = 2q + 1) membership in the order-q QR subgroup is exactly
+    (a/p) == 1 — a gcd-speed screen, vs a full modexp per element."""
+    a %= n
+    result = 1
+    while a:
+        while a % 2 == 0:
+            a //= 2
+            if n % 8 in (3, 5):
+                result = -result
+        a, n = n, a
+        if a % 4 == 3 and n % 4 == 3:
+            result = -result
+        a %= n
+    return result if n == 1 else 0
+
+
+def _pvss_scalar(seed: bytes, k: int, q: int) -> int:
+    """Deterministic scalar expansion: 512 hash bits mod q (bias
+    negligible at these widths); index m doubles as the DLEQ witness
+    slot."""
+    tag = seed + struct.pack(">I", k)
+    v = int.from_bytes(
+        hashlib.sha256(tag + b"|a").digest()
+        + hashlib.sha256(tag + b"|b").digest(),
+        "big",
+    ) % q
+    return v or 1
+
+
+def _pvss_ctx(
+    version: int,
+    dealer: str,
+    receiver: str,
+    kind: int,
+    commits: Sequence[int],
+    group: GroupParams,
+) -> bytes:
+    """The Fiat-Shamir statement prefix: binds the proof to this
+    (version, dealer, receiver, share-kind) slot AND the dealer's
+    commitment vector, so a proof cannot be replayed across slots or
+    against substituted commitments."""
     nb = group.nbytes
-    if len(blob) != 2 * nb + 32:
-        raise ValueError("bad share blob length")
-    ct, tag = blob[: 2 * nb], blob[2 * nb :]
-    if not _hmac.compare_digest(
-        _hmac.new(key, ct, hashlib.sha256).digest(), tag
-    ):
-        raise ValueError("share blob tag mismatch")
-    plain = bytes(
-        x ^ y for x, y in zip(ct, _keystream(key, len(ct)))
+    h = hashlib.sha256(
+        b"rcfgpvss|%d|" % version
+        + dealer.encode("utf-8")
+        + b"|"
+        + receiver.encode("utf-8")
+        + b"|%d|" % kind
     )
+    for c in commits:
+        h.update(c.to_bytes(nb, "big"))
+    return h.digest()
+
+
+def _pvss_challenge(
+    ctx: bytes,
+    y: int,
+    cipher: Sequence[int],
+    t1: int,
+    t2: int,
+    group: GroupParams,
+) -> int:
+    nb = group.nbytes
+    h = hashlib.sha256(ctx)
+    h.update(y.to_bytes(nb, "big"))
+    for v in cipher:
+        h.update(v.to_bytes(nb, "big"))
+    h.update(t1.to_bytes(nb, "big"))
+    h.update(t2.to_bytes(nb, "big"))
+    return int.from_bytes(h.digest(), "big") % group.q
+
+
+def pvss_encrypt_share(
+    s: int,
+    y: int,
+    rho_seed: bytes,
+    ctx: bytes,
+    group: GroupParams,
+    eng=None,
+) -> bytes:
+    """One share -> one blob section: m chunk ciphertexts followed by
+    the compact DLEQ proof (c, z).  ``rho_seed`` expands to the m
+    chunk randomizers and the proof witness (CSPRNG-derived in
+    production, seed-derived in fuzz replays)."""
+    p, q, g = group.p, group.q, group.g
+    nb = group.nbytes
+    m = _pvss_chunk_count(group)
+    powers, _ = _pvss_tables(group)
+    weights = _pvss_weights(group)
+    eng = eng if eng is not None else _pvss_engine(group)
+    s %= q
+    chunks = [
+        (s >> (PVSS_CHUNK_BITS * (m - 1 - k))) & (PVSS_CHUNK_BASE - 1)
+        for k in range(m)
+    ]
+    rhos = [_pvss_scalar(rho_seed, k, q) for k in range(m)]
+    w = _pvss_scalar(rho_seed, m, q)
+    out = eng.pow_batch(
+        [g] * m + [y] * m + [g, y], rhos + rhos + [w, w]
+    )
+    cipher: List[int] = []
+    for k in range(m):
+        cipher.append(out[k])  # A_k = g^{rho_k}
+        cipher.append(out[m + k] * powers[chunks[k]] % p)  # E_k
+    t1, t2 = out[2 * m], out[2 * m + 1]
+    rho = sum(r * wk for r, wk in zip(rhos, weights)) % q
+    c = _pvss_challenge(ctx, y, cipher, t1, t2, group)
+    z = (w + c * rho) % q
     return (
-        int.from_bytes(plain[:nb], "big"),
-        int.from_bytes(plain[nb:], "big"),
+        b"".join(v.to_bytes(nb, "big") for v in cipher)
+        + c.to_bytes(32, "big")
+        + z.to_bytes(nb, "big")
     )
+
+
+def _pvss_parse_section(
+    blob: bytes, kind: int, group: GroupParams
+) -> Tuple[List[int], int, int]:
+    nb = group.nbytes
+    m = _pvss_chunk_count(group)
+    half = pvss_blob_len(group) // 2
+    sec = blob[kind * half : (kind + 1) * half]
+    cipher = [
+        int.from_bytes(sec[i * nb : (i + 1) * nb], "big")
+        for i in range(2 * m)
+    ]
+    off = 2 * m * nb
+    c = int.from_bytes(sec[off : off + 32], "big")
+    z = int.from_bytes(sec[off + 32 :], "big")
+    return cipher, c, z
+
+
+def pvss_verify_dealing(
+    dealing: "Dealing",
+    pubs: Dict[str, int],
+    group: GroupParams,
+    eng=None,
+) -> bool:
+    """PUBLIC verification of every receiver blob in a dealing against
+    the dealer's own commitments — a pure function of (dealing bytes,
+    receiver DH public keys), so every honest node admits or excludes
+    the dealer identically.  ``pubs`` maps receiver id -> static-DH
+    public key in ``sorted(dealing.blobs)`` == new-roster order (the
+    1-based Shamir index is the position in that order)."""
+    p, q, g = group.p, group.q, group.g
+    m = _pvss_chunk_count(group)
+    blob_len = pvss_blob_len(group)
+    eng = eng if eng is not None else _pvss_engine(group)
+    ids = sorted(dealing.blobs)
+    entries = []  # (y, X_j, cipher, c, z)
+    for j, rid in enumerate(ids, start=1):
+        blob = dealing.blobs[rid]
+        y = pubs.get(rid)
+        if y is None or len(blob) != blob_len:
+            return False
+        for kind, commits in (
+            (0, dealing.tpke_commits),
+            (1, dealing.coin_commits),
+        ):
+            cipher, c, z = _pvss_parse_section(blob, kind, group)
+            if not (0 <= c < q and 0 <= z < q):
+                return False
+            for v in cipher:
+                # subgroup screen: QR test at gcd speed
+                if not (0 < v < p) or _jacobi(v, p) != 1:
+                    return False
+            # X_j = prod C_i^{j^i}, Horner in the (small) exponent j
+            x_j = commits[-1]
+            for cm in reversed(commits[:-1]):
+                x_j = pow(x_j, j, p) * cm % p
+            ctx = _pvss_ctx(
+                dealing.version, dealing.dealer, rid, kind, commits,
+                group,
+            )
+            entries.append((y, x_j, cipher, c, z, ctx))
+    # Abar/Ebar via Horner in the chunk base (16 squarings/step beats
+    # a full modexp per weight), X^{-1} and the DLEQ legs batched
+    aggs = []
+    for y, x_j, cipher, c, z, ctx in entries:
+        abar, ebar = cipher[0], cipher[1]
+        for k in range(1, m):
+            abar = pow(abar, PVSS_CHUNK_BASE, p) * cipher[2 * k] % p
+            ebar = (
+                pow(ebar, PVSS_CHUNK_BASE, p) * cipher[2 * k + 1] % p
+            )
+        aggs.append((abar, ebar))
+    x_invs = eng.pow_batch(
+        [e[1] for e in entries], [p - 2] * len(entries)
+    )
+    bases: List[int] = []
+    exps: List[int] = []
+    for (y, x_j, cipher, c, z, ctx), (abar, ebar), x_inv in zip(
+        entries, aggs, x_invs
+    ):
+        u = ebar * x_inv % p
+        neg_c = (q - c) % q
+        bases.extend((g, y, abar, u))
+        exps.extend((z, z, neg_c, neg_c))
+    legs = eng.pow_batch(bases, exps)
+    for i, (y, x_j, cipher, c, z, ctx) in enumerate(entries):
+        g_z, y_z, a_negc, u_negc = legs[4 * i : 4 * i + 4]
+        t1 = g_z * a_negc % p
+        t2 = y_z * u_negc % p
+        if _pvss_challenge(ctx, y, cipher, t1, t2, group) != c:
+            return False
+    return True
+
+
+def pvss_decrypt_share(
+    blob: bytes, kind: int, x: int, group: GroupParams, eng=None
+) -> int:
+    """Receiver-side decode of one share section under the receiver's
+    static-DH secret ``x``.  Raises ValueError when a chunk falls
+    outside the canonical 16-bit range — the documented residual a
+    publicly-verified dealing can still hit; the caller fails loudly
+    rather than diverging."""
+    p, q = group.p, group.q
+    m = _pvss_chunk_count(group)
+    weights = _pvss_weights(group)
+    _, dlog = _pvss_tables(group)
+    eng = eng if eng is not None else _pvss_engine(group)
+    cipher, _c, _z = _pvss_parse_section(blob, kind, group)
+    a_inv = eng.pow_batch(cipher[0::2], [(q - x % q) % q] * m)
+    s = 0
+    for k in range(m):
+        v = dlog.get(cipher[2 * k + 1] * a_inv[k] % p)
+        if v is None:
+            raise ValueError(
+                f"PVSS chunk {k} outside the canonical range"
+            )
+        s = (s + v * weights[k]) % q
+    return s
+
+
+# memo for the (pure) public verification: settled dealing txs are
+# re-scanned on WAL replay and by every node of an in-process cluster;
+# the verdict is a function of the tx bytes + the (agreed) receiver
+# key table, so one computation serves them all
+_PVSS_VERDICTS: Dict[bytes, bool] = {}
+_PVSS_VERDICT_CAP = 512
 
 
 def key_material_digest(
@@ -631,6 +901,24 @@ class ReconfigManager:
         ).digest()
         return int.from_bytes(h[:8], "big")
 
+    def _blob_seed(self, rid: str, kind: int) -> bytes:
+        """Expansion seed for one blob's chunk randomizers + DLEQ
+        witness: CSPRNG in production, config-seed-derived in fuzz
+        replays (same policy as ``_dealing_seed``)."""
+        hb = self._hb
+        if hb.config.seed is None:
+            import secrets
+
+            return secrets.token_bytes(32)  # staticcheck: allow[DET001] PVSS randomizers
+        p = self._pending
+        return hashlib.sha256(
+            b"rcfgpvssrho|%d|%d|%d|"
+            % (hb.config.seed, p.spec.version, kind)
+            + hb.node_id.encode("utf-8")
+            + b"|"
+            + rid.encode("utf-8")
+        ).digest()
+
     def _deal_now(self) -> None:
         hb = self._hb
         p = self._pending
@@ -646,25 +934,35 @@ class ReconfigManager:
         deal_c = DkgDealing(
             old_index, spec.n, t_new, group, seed=self._dealing_seed(1)
         )
+        tpke_commits = deal_t.commitments(backend="cpu")
+        coin_commits = deal_c.commitments(backend="cpu")
+        eng = _pvss_engine(group)
         blobs: Dict[str, bytes] = {}
-        my_secret = old_view.keys.coin_share.value
         for j, rid in enumerate(spec.member_ids, start=1):
-            peer_pub = self._dh_pub_for(rid)
-            key = _share_key(
-                spec.version,
-                hb.node_id,
-                rid,
-                dh_point(my_secret, peer_pub, group),
-                group,
-            )
-            blobs[rid] = encrypt_share_pair(
-                key, deal_t.share_for(j), deal_c.share_for(j), group
-            )
+            y = self._dh_pub_for(rid)
+            parts: List[bytes] = []
+            for kind, (deal, commits) in enumerate(
+                ((deal_t, tpke_commits), (deal_c, coin_commits))
+            ):
+                parts.append(
+                    pvss_encrypt_share(
+                        deal.share_for(j),
+                        y,
+                        self._blob_seed(rid, kind),
+                        _pvss_ctx(
+                            spec.version, hb.node_id, rid, kind,
+                            commits, group,
+                        ),
+                        group,
+                        eng,
+                    )
+                )
+            blobs[rid] = b"".join(parts)
         tx = encode_dealing_tx(
             spec.version,
             hb.node_id,
-            deal_t.commitments(backend="cpu"),
-            deal_c.commitments(backend="cpu"),
+            tpke_commits,
+            coin_commits,
             blobs,
             group,
         )
@@ -741,7 +1039,38 @@ class ReconfigManager:
             if rid == hb.node_id:
                 continue
             if rid in old_ids and hb.node_id in old_ids:
-                continue  # surviving pair: existing key stays
+                # surviving pair: its fresh key is STAGED, not
+                # installed — see rotation_pair_keys
+                continue
+            dh = dh_point(mine, self._dh_pub_for(rid), group)
+            out[rid] = pair_mac_key(
+                spec.version, dh, hb.node_id, rid, group
+            )
+        return out
+
+    def rotation_pair_keys(self, spec: ReconfigSpec) -> Dict[str, bytes]:
+        """Fresh version-keyed MAC keys for this node's SURVIVING
+        pairs (both ends in the old AND the new roster) — the MAC
+        rotation's key schedule.  Installed via ``stage_peer_key`` at
+        discovery (verify-either), promoted to the signing key at the
+        activation boundary, with the old key dropped at teardown; a
+        hard swap instead would reject every in-flight frame
+        straddling the boundary."""
+        hb = self._hb
+        group = hb.group
+        old_ids = set(hb.active_view.member_ids)
+        if (
+            hb.node_id not in old_ids
+            or hb.node_id not in spec.member_ids
+        ):
+            return {}  # joiners and retirees have no surviving pairs
+        mine = self._dh_secret()
+        out: Dict[str, bytes] = {}
+        for rid in spec.member_ids:
+            if rid not in old_ids:
+                continue  # joiner pair: installed, not staged
+            # the self pair rotates too (loopback frames must track
+            # the version's NodeKeys)
             dh = dh_point(mine, self._dh_pub_for(rid), group)
             out[rid] = pair_mac_key(
                 spec.version, dh, hb.node_id, rid, group
@@ -817,10 +1146,8 @@ class ReconfigManager:
             return
         if sorted(dealing.blobs) != list(spec.member_ids):
             return  # must key every new member
-        nb = hb.group.nbytes
-        if any(
-            len(b) != 2 * nb + 32 for b in dealing.blobs.values()
-        ):
+        blen = pvss_blob_len(hb.group)
+        if any(len(b) != blen for b in dealing.blobs.values()):
             return
         ok = validate_commitments(
             [dealing.tpke_commits, dealing.coin_commits],
@@ -830,9 +1157,44 @@ class ReconfigManager:
         )
         if not all(ok):
             return  # commitment outside the prime-order subgroup
+        if not self._pvss_check(tx, dealing, spec):
+            # a blob fails public verification (e.g. targeted garbage
+            # to one receiver): EVERY honest node rejects this dealing
+            # at this log position — the dealer is excluded from Q
+            # deterministically, no complaint round needed
+            tr = hb.trace
+            if tr is not None:
+                tr.instant(
+                    "reconfig",
+                    "pvss_reject",
+                    version=dealing.version,
+                    dealer=dealing.dealer,
+                )
+            return
         p.dealings[dealing.dealer] = dealing
         if len(p.dealings) >= p.need:
             self._finalize(epoch)
+
+    def _pvss_check(self, tx: bytes, dealing: Dealing, spec) -> bool:
+        """Memoized ``pvss_verify_dealing``: the verdict is a pure
+        function of the tx bytes + the version's (agreed) receiver key
+        table, and the same settled tx is re-scanned on WAL replay and
+        by every node of an in-process cluster."""
+        digest = hashlib.sha256(tx).digest()
+        verdict = _PVSS_VERDICTS.get(digest)
+        if verdict is None:
+            group = self._hb.group
+            pubs = {
+                rid: self._dh_pub_for(rid)
+                for rid in spec.member_ids
+            }
+            verdict = pvss_verify_dealing(
+                dealing, pubs, group, _pvss_engine(group)
+            )
+            while len(_PVSS_VERDICTS) >= _PVSS_VERDICT_CAP:
+                _PVSS_VERDICTS.pop(next(iter(_PVSS_VERDICTS)))
+            _PVSS_VERDICTS[digest] = verdict
+        return verdict
 
     def _finalize(self, epoch: int) -> None:
         """Q is complete at the settlement of ``epoch``: derive the
@@ -900,25 +1262,31 @@ class ReconfigManager:
         group = hb.group
         my_index = spec.member_ids.index(hb.node_id) + 1
         mine = self._dh_secret()
+        eng = _pvss_engine(group)
         s_tpke_total = 0
         s_coin_total = 0
         check_items = []
         for d in dealers:
             dealing = p.dealings[d]
-            key = _share_key(
-                spec.version,
-                d,
-                hb.node_id,
-                dh_point(mine, self._dh_pub_for(d), group),
-                group,
-            )
-            s_t, s_c = decrypt_share_pair(
-                key, dealing.blobs[hb.node_id], group
-            )
+            blob = dealing.blobs[hb.node_id]
+            try:
+                s_t = pvss_decrypt_share(blob, 0, mine, group, eng)
+                s_c = pvss_decrypt_share(blob, 1, mine, group, eng)
+            except ValueError as exc:
+                # a PUBLICLY verified dealing can only fail here via
+                # the non-canonical-chunk residual (module docstring):
+                # fail LOUDLY — diverging silently would fork the
+                # roster
+                raise RuntimeError(
+                    f"{hb.node_id}: reshare v{spec.version} blob from "
+                    f"dealer {d} failed chunk decode ({exc})"
+                ) from exc
             check_items.append((dealing.tpke_commits, my_index, s_t))
             check_items.append((dealing.coin_commits, my_index, s_c))
             s_tpke_total = (s_tpke_total + s_t) % group.q
             s_coin_total = (s_coin_total + s_c) % group.q
+        # defense-in-depth sanity: with canonical chunks the DLEQ
+        # already pins g^s == X_j, so this can only fire on a bug
         verdicts = verify_dealer_shares(
             check_items, group=group, backend="cpu"
         )
@@ -930,30 +1298,20 @@ class ReconfigManager:
                     if not ok
                 }
             )
-            # a qualified dealer encrypted us garbage: fail LOUDLY
-            # (diverging silently would fork the roster) — see the
-            # module docstring's known-limitation note
             raise RuntimeError(
                 f"{hb.node_id}: reshare v{spec.version} shares from "
                 f"dealers {bad} fail commitment verification"
             )
-        old_view = hb.active_view
-        old_ids = set(old_view.member_ids)
+        # MAC rotation: EVERY pair of the new roster gets a fresh
+        # version-keyed MAC key — surviving pairs included (they stage
+        # it at discovery and promote at activation; see the module
+        # docstring and HmacAuthenticator's rotation half)
         mac_keys: Dict[str, bytes] = {}
-        self_old = hb.node_id in old_ids
         for rid in spec.member_ids:
-            if self_old and (rid in old_ids):
-                mac_keys[rid] = old_view.keys.mac_keys[rid]
-            elif rid == hb.node_id:
-                dh = dh_point(mine, self._dh_pub_for(rid), group)
-                mac_keys[rid] = pair_mac_key(
-                    spec.version, dh, rid, rid, group
-                )
-            else:
-                dh = dh_point(mine, self._dh_pub_for(rid), group)
-                mac_keys[rid] = pair_mac_key(
-                    spec.version, dh, hb.node_id, rid, group
-                )
+            dh = dh_point(mine, self._dh_pub_for(rid), group)
+            mac_keys[rid] = pair_mac_key(
+                spec.version, dh, hb.node_id, rid, group
+            )
         return NodeKeys(
             tpke_pub=tpke_pub,
             tpke_share=ThresholdSecretShare(
@@ -1019,4 +1377,8 @@ __all__ = [
     "dh_point",
     "key_material_digest",
     "finalize_public",
+    "pvss_blob_len",
+    "pvss_encrypt_share",
+    "pvss_verify_dealing",
+    "pvss_decrypt_share",
 ]

@@ -113,6 +113,8 @@ class LockstepCluster:
         if member_ids is None:
             member_ids = [f"node{i:03d}" for i in range(cfg.n)]
         self.ids: List[str] = sorted(member_ids)
+        self._base_key_seed = key_seed
+        self._group = group
         self.keys = setup_keys(cfg, self.ids, seed=key_seed, group=group)
         self.crypto = get_backend(cfg)
         k0 = self.keys[self.ids[0]]
@@ -156,6 +158,59 @@ class LockstepCluster:
         same list; ``node_id`` is accepted for SimulatedCluster API
         compatibility."""
         return list(self.committed_batches)
+
+    def reconfigure(
+        self,
+        join: Sequence[str] = (),
+        retire: Sequence[str] = (),
+        key_seed: Optional[int] = None,
+    ) -> None:
+        """The lockstep analogue of the reshare ceremony's ACTIVATION
+        boundary: between epochs, swap the roster and rebind fresh
+        threshold key material.  The asynchronous plane reaches the
+        same switch through the in-band ceremony (PVSS dealings, the
+        RCFG record, the frontier-gated activation); the lockstep
+        plane models the BENIGN schedule only, so it applies the
+        already-agreed outcome as one synchronous step — same roster
+        arithmetic (n, f, data shards re-derived under the active
+        quorum mode), same commit rule, continuous epoch counter.
+        Pending txs queued at a retiring member re-route round-robin
+        to the survivors (the message-passing twin's clients fail
+        over the same way)."""
+        import dataclasses as _dc
+
+        ids = sorted((set(self.ids) | set(join)) - set(retire))
+        if not ids:
+            raise ValueError("reconfigure would empty the roster")
+        stranded: List[bytes] = []
+        for nid in retire:
+            stranded.extend(self.queues.get(nid, ()))
+        cfg = _dc.replace(self.config, n=len(ids), f=None)
+        self.config = cfg
+        self.ids = ids
+        self.keys = setup_keys(
+            cfg,
+            ids,
+            seed=self._next_key_seed() if key_seed is None else key_seed,
+            group=self._group,
+        )
+        self.crypto = get_backend(cfg)
+        k0 = self.keys[ids[0]]
+        self.tpke = self.crypto.tpke(k0.tpke_pub)
+        self.coin = self.crypto.coin(k0.coin_pub)
+        self.queues = {
+            nid: self.queues.get(nid, collections.deque()) for nid in ids
+        }
+        self.b = max(cfg.batch_size, cfg.n)
+        for tx in stranded:
+            self.submit(tx)
+
+    def _next_key_seed(self) -> int:
+        """Deterministic proactive-rekey schedule: version v uses
+        key_seed + v (the async ceremony derives fresh material from
+        the dealings; here the seed schedule stands in for it)."""
+        self._key_version = getattr(self, "_key_version", 0) + 1
+        return self._base_key_seed + self._key_version
 
     # -- one epoch ---------------------------------------------------------
 

@@ -65,9 +65,14 @@ class VoteBank:
         f: int,
         inst_ids: Optional[Sequence[str]] = None,
         metrics=None,
+        quorum_large: Optional[int] = None,
     ) -> None:
         self.members: List[str] = sorted(member_ids)
         self.f = f
+        # the bin_values growth threshold: 2f+1 in the baseline trust
+        # model, n-f under Config.reduced_quorum (identical whenever
+        # n = 3f+1 exactly — see Config.quorum_large)
+        self.q_large = 2 * f + 1 if quorum_large is None else quorum_large
         # owner-node metrics (None in standalone unit tests): only the
         # duplicate-vote absorption counter is touched here
         self.metrics = metrics
@@ -247,6 +252,7 @@ class VoteBank:
             si_all, pi_all = si_all[first_idx], pi_all[first_idx]
         vi = 1 if value else 0
         f = self.f
+        q_large = self.q_large
         bbas = self.bbas
         if is_bval:
             seen_plane = self.bval_seen[:, vi]
@@ -266,14 +272,14 @@ class VoteBank:
             cnt = self.bval_cnt[vi]
             before = cnt[uniq]
             cnt[uniq] = after = before + adds.astype(np.int32)
-            # f+1 same bval -> relay once; 2f+1 -> bin_values union
+            # f+1 same bval -> relay once; q_large -> bin_values union
             # (docs/BBA-EN.md:47-58) — interval crossings, fired after
             # ALL of the wave's adds landed
             for i in uniq[(before < f + 1) & (after >= f + 1)]:
                 bba = bbas[i]
                 if bba is not None and not bba.halted:
                     bba.on_bval_relay(value)
-            for i in uniq[(before < 2 * f + 1) & (after >= 2 * f + 1)]:
+            for i in uniq[(before < q_large) & (after >= q_large)]:
                 bba = bbas[i]
                 if bba is not None and not bba.halted:
                     bba.on_bval_bin(value)
@@ -356,9 +362,9 @@ class VoteBank:
             cnt[new] += 1
             cnts = cnt[new]
             relay = new[cnts == self.f + 1]
-            grow = new[cnts == 2 * self.f + 1]
+            grow = new[cnts == self.q_large]
             bbas = self.bbas
-            # f+1 same bval -> relay once; 2f+1 -> bin_values union
+            # f+1 same bval -> relay once; q_large -> bin_values union
             # (docs/BBA-EN.md:47-58)
             for i in relay:
                 bba = bbas[i]

@@ -287,6 +287,16 @@ class HmacAuthenticator(Authenticator):
             peer: _hmac_sha256_fn(key)
             for peer, key in self._peer_keys.items()
         }
+        # MAC rotation (protocol.reconfig): the SECONDARY verify map.
+        # A surviving pair's next-version key is STAGED here at
+        # reconfig discovery (verification accepts either key, signing
+        # stays on the old one), PROMOTED to primary at the activation
+        # boundary (the old key drops into this map so in-flight
+        # frames still verify), and the leftover alternate is dropped
+        # at retirement teardown — after which a stale pre-rotation
+        # key no longer authenticates anything.
+        self._alt_keys: "Dict[str, bytes]" = {}
+        self._alt_macs: "Dict[str, Callable[[bytes], bytes]]" = {}
 
     def set_peer_key(self, peer_id: str, key: bytes) -> None:
         """Install (or rotate) one pair key — the dynamic-membership
@@ -299,12 +309,55 @@ class HmacAuthenticator(Authenticator):
         self._peer_keys[peer_id] = key
         self._macs[peer_id] = _hmac_sha256_fn(key)
 
+    def stage_peer_key(self, peer_id: str, key: bytes) -> None:
+        """Stage a SURVIVING pair's next-version key for verification
+        only (MAC rotation step 1, at reconfig discovery): inbound
+        frames verify under the current OR the staged key, outbound
+        frames keep signing under the current one.  Nodes cross the
+        activation boundary at different instants, so a hard swap
+        would reject every in-flight frame straddling it; staging at
+        discovery — the earliest log position all survivors share —
+        makes the handover seamless in both directions."""
+        if key == self._peer_keys.get(peer_id):
+            return  # same-key "rotation" (e.g. replay): nothing staged
+        self._alt_keys[peer_id] = key
+        self._alt_macs[peer_id] = _hmac_sha256_fn(key)
+
+    def promote_staged_key(self, peer_id: str) -> None:
+        """Switch signing to the staged key (MAC rotation step 2, at
+        the activation boundary): the staged key becomes primary and
+        the OLD key drops into the secondary verify map, so frames
+        MAC'd just before the boundary still verify until teardown."""
+        key = self._alt_keys.get(peer_id)
+        if key is None:
+            return
+        old_key = self._peer_keys.get(peer_id)
+        old_fn = self._macs.get(peer_id)
+        self._peer_keys[peer_id] = key
+        self._macs[peer_id] = self._alt_macs[peer_id]
+        if old_key is not None:
+            self._alt_keys[peer_id] = old_key
+            self._alt_macs[peer_id] = old_fn
+        else:
+            del self._alt_keys[peer_id]
+            del self._alt_macs[peer_id]
+
+    def drop_alt_key(self, peer_id: str) -> None:
+        """Forget the secondary key (MAC rotation step 3, at
+        retirement teardown): from here a frame MAC'd under the
+        pre-rotation key is rejected — the stale-key regression the
+        rotation exists to create."""
+        self._alt_keys.pop(peer_id, None)
+        self._alt_macs.pop(peer_id, None)
+
     def drop_peer(self, peer_id: str) -> None:
         """Retire one pair key: frames to/from the peer no longer
         sign or verify (the MAC-layer half of peer retirement —
         transport.health tears down the dial half)."""
         self._peer_keys.pop(peer_id, None)
         self._macs.pop(peer_id, None)
+        self._alt_keys.pop(peer_id, None)
+        self._alt_macs.pop(peer_id, None)
 
     @staticmethod
     def pair_key(master_secret: bytes, a: str, b: str) -> bytes:
@@ -364,8 +417,12 @@ class HmacAuthenticator(Authenticator):
         mac_fn = self._macs.get(msg.sender_id)
         if mac_fn is None:  # not a roster member we share a key with
             return False
-        return hmac.compare_digest(
-            mac_fn(signing_bytes(msg)), msg.signature
+        sb = signing_bytes(msg)
+        if hmac.compare_digest(mac_fn(sb), msg.signature):
+            return True
+        alt_fn = self._alt_macs.get(msg.sender_id)
+        return alt_fn is not None and hmac.compare_digest(
+            alt_fn(sb), msg.signature
         )
 
     def verify_wire(self, msg: Message, signing_prefix: bytes) -> bool:
@@ -386,7 +443,12 @@ class HmacAuthenticator(Authenticator):
         mac_fn = self._macs.get(msg.sender_id)
         if mac_fn is None:
             return False
-        return hmac.compare_digest(mac_fn(signing_prefix), msg.signature)
+        if hmac.compare_digest(mac_fn(signing_prefix), msg.signature):
+            return True
+        alt_fn = self._alt_macs.get(msg.sender_id)
+        return alt_fn is not None and hmac.compare_digest(
+            alt_fn(signing_prefix), msg.signature
+        )
 
     def verify_wire_many(self, msgs, signing_prefixes) -> "List[bool]":
         """Wave verify fast path: the per-sender MAC context resolves
@@ -394,18 +456,27 @@ class HmacAuthenticator(Authenticator):
         runs — each peer's bundle fan-in arrives together), and each
         verdict is two SHA-256 context copies + a compare_digest."""
         macs = self._macs
+        alt_macs = self._alt_macs
         out: List[bool] = []
         last_sender: Optional[str] = None
         mac_fn = None
+        alt_fn = None
         for msg, prefix in zip(msgs, signing_prefixes):
             sender = msg.sender_id
             if sender != last_sender:
                 mac_fn = macs.get(sender)
+                alt_fn = alt_macs.get(sender) if alt_macs else None
                 last_sender = sender
             if mac_fn is None:
                 out.append(False)
                 continue
-            out.append(hmac.compare_digest(mac_fn(prefix), msg.signature))
+            out.append(
+                hmac.compare_digest(mac_fn(prefix), msg.signature)
+                or (
+                    alt_fn is not None
+                    and hmac.compare_digest(alt_fn(prefix), msg.signature)
+                )
+            )
         return out
 
     def sign_wire_many(self, msg: Message, receiver_ids) -> "Dict[str, bytes]":

@@ -867,16 +867,25 @@ class ChannelNetwork:
 
         Quiescence is two-level: when the pending queue drains, every
         endpoint gets its idle callback (running deferred crypto and
-        flushing coalesced bundles); only when a full idle phase
-        produces no new traffic is the network done.
+        flushing coalesced bundles); only when TWO consecutive idle
+        phases produce no new traffic is the network done.  The second
+        pass is the stall-watchdog window (protocol plane's
+        ``_maybe_chase_stall``): a handler can only recognize "no
+        inbound since my previous idle callback" on an idle that
+        FOLLOWS the quiet one, so a single-pass exit would always
+        terminate one callback too early for it to fire.  For handlers
+        without a watchdog the extra pass flushes nothing and is
+        behaviorally inert.
         """
         t0 = time.monotonic()
         steps = 0
+        quiet_idles = 0
         while steps < max_steps:
             if deadline_s is not None and time.monotonic() - t0 > deadline_s:
                 break
             if self.step():
                 steps += 1
+                quiet_idles = 0
                 continue
             self.idle_phase()
             if not self._pending:
@@ -886,7 +895,11 @@ class ChannelNetwork:
                     # their deadline instead of declaring the network
                     # drained
                     continue
-                break
+                quiet_idles += 1
+                if quiet_idles >= 2:
+                    break
+            else:
+                quiet_idles = 0
         return steps
 
 

@@ -398,10 +398,25 @@ class ValidatorHost:
         self._backoffs: Dict[str, Backoff] = {}
         self._backoffs_lock = new_lock()
         self.log = NodeLogger(node_id, "host")
-        self._auth = HmacAuthenticator(node_id, keys.mac_keys)
         # inbound verification looks up the pair key by sender id, so
         # one authenticator verifies all peers; signing is bound to
         # (node_id, receiver) pairs
+        if config.attested_log:
+            from cleisthenes_tpu.protocol.attest import (
+                AttestationDirectory,
+                AttestingAuthenticator,
+            )
+
+            # each host holds its OWN simulated TEE NVRAM (one sealed
+            # counter store per machine); fork evidence against peers
+            # aggregates locally and surfaces through attest_stats
+            self.attest_dir = AttestationDirectory()
+            self._auth = AttestingAuthenticator(
+                node_id, keys.mac_keys, self.attest_dir.attach(node_id)
+            )
+        else:
+            self.attest_dir = None
+            self._auth = HmacAuthenticator(node_id, keys.mac_keys)
         self.dispatcher = SerialDispatcher(name=f"dispatch-{node_id}")
         self.server = GrpcServer(
             listen_addr,

@@ -423,6 +423,15 @@ class Message:
     timestamp: float
     payload: Payload
     signature: bytes = b""
+    # Simulated-TEE attestation trailer (Config.attested_log,
+    # protocol/attest.py): an opaque blob appended AFTER the signature
+    # — (incarnation, sender counter, refused flag, attestation MAC)
+    # issued by the sender's AttestationVault.  Empty on the baseline
+    # arm, where the frame bytes are identical to the pre-attestation
+    # wire format.  Not covered by the envelope MAC (it carries its
+    # own MAC binding the signing prefix), so the codec treats it as
+    # an optional TLV trailer.
+    attestation: bytes = b""
 
 
 # ---------------------------------------------------------------------------
@@ -1058,15 +1067,34 @@ def payload_body_count(p: Payload) -> int:
     return len(p.items) if isinstance(p, BundlePayload) else 1
 
 
-def attach_signature(signing: bytes, signature: bytes) -> bytes:
+# Tag byte opening the optional attestation trailer
+# (``signing || len(sig) || sig || TAG || len(att) || att``).  A
+# distinct tag keeps the trailer self-describing: a frame ending at
+# the signature is the baseline arm, anything else must be exactly
+# one tagged attestation blob (canonical-or-reject).
+ATTEST_TAG = 0xA7
+
+
+def attach_signature(
+    signing: bytes, signature: bytes, attestation: bytes = b""
+) -> bytes:
     """Complete a frame from its pre-computed signing bytes: the wire
-    layout is ``signing_bytes || len(sig) || sig``, so a broadcast can
-    encode the envelope once and append a per-receiver MAC."""
-    return signing + struct.pack(">I", len(signature)) + signature
+    layout is ``signing_bytes || len(sig) || sig`` plus, when the
+    attested-log arm is on, the tagged attestation trailer — so a
+    broadcast can encode the envelope once and append a per-receiver
+    MAC (and per-receiver attestation)."""
+    frame = signing + struct.pack(">I", len(signature)) + signature
+    if attestation:
+        frame += (
+            struct.pack(">BI", ATTEST_TAG, len(attestation)) + attestation
+        )
+    return frame
 
 
 def encode_message(msg: Message) -> bytes:
-    return attach_signature(signing_bytes(msg), msg.signature)
+    return attach_signature(
+        signing_bytes(msg), msg.signature, msg.attestation
+    )
 
 
 class FrameDecodeMemo(BoundedFifoMemo):
@@ -1142,11 +1170,24 @@ def decode_frame_shared(
     if sig_len > MAX_FIELD_BYTES:
         raise ValueError(f"field length {sig_len} exceeds cap")
     sig_off = prefix_end + 4
-    if sig_off + sig_len != n:
-        raise ValueError(
-            "truncated frame" if sig_off + sig_len > n
-            else "trailing bytes in frame"
-        )
+    sig_end = sig_off + sig_len
+    if sig_end > n:
+        raise ValueError("truncated frame")
+    attestation = b""
+    if sig_end != n:
+        # optional attested-log trailer: exactly one tagged blob
+        if sig_end + 5 > n or data[sig_end] != ATTEST_TAG:
+            raise ValueError("trailing bytes in frame")
+        (att_len,) = _U32.unpack_from(data, sig_end + 1)
+        if att_len > MAX_FIELD_BYTES:
+            raise ValueError(f"field length {att_len} exceeds cap")
+        att_off = sig_end + 5
+        if att_off + att_len != n:
+            raise ValueError(
+                "truncated frame" if att_off + att_len > n
+                else "trailing bytes in frame"
+            )
+        attestation = data[att_off:]
     view = memoryview(data)
     prefix = view[:prefix_end]
     digest = hashlib.sha256(prefix).digest()
@@ -1172,7 +1213,8 @@ def decode_frame_shared(
             sender_id=sender,
             timestamp=ts,
             payload=payload,
-            signature=data[sig_off:],
+            signature=data[sig_off:sig_end],
+            attestation=attestation,
         ),
         prefix,
     )
@@ -1208,8 +1250,14 @@ def decode_frame(
     body = r.bytes_()
     signing_prefix = data[: 6 + r._o]
     sig = r.bytes_()
+    attestation = b""
     if not r.done():
-        raise ValueError("trailing bytes in frame")
+        # optional attested-log trailer: exactly one tagged blob
+        if r.u8() != ATTEST_TAG:
+            raise ValueError("trailing bytes in frame")
+        attestation = r.bytes_()
+        if not r.done():
+            raise ValueError("trailing bytes in frame")
     if payload_memo is None:
         payload = _decode_payload(kind, body)
     else:
@@ -1226,6 +1274,7 @@ def decode_frame(
             timestamp=ts,
             payload=payload,
             signature=sig,
+            attestation=attestation,
         ),
         signing_prefix,
     )
@@ -1326,5 +1375,7 @@ __all__ = [
     "payload_body_count",
     "signing_bytes",
     "signing_bytes_shared",
+    "attach_signature",
+    "ATTEST_TAG",
     "MAX_FIELD_BYTES",
 ]

@@ -91,6 +91,14 @@ _PB_TAG_INGRESS_SUBMIT = 19
 _PB_TAG_INGRESS_ACK = 20
 _PB_TAG_INGRESS_SUB = 21
 _PB_TAG_INGRESS_BATCH = 22
+# attested sender log (protocol/attest.py): the envelope-level
+# attestation trailer — NOT a payload kind, it rides beside the
+# signature on every frame when Config.attested_log is armed.  Raw
+# blob, next free tag; a stock decoder skips it per proto3
+# unknown-field semantics, so a reference peer interoperates on the
+# baseline arm and simply cannot join an attested roster (its frames
+# carry no stamp and fail attestation verify — by design).
+_PB_TAG_ATTEST = 23
 
 # A Byzantine frame must not make us allocate from a length varint.
 MAX_PB_FIELD = 64 * 1024 * 1024
@@ -203,10 +211,16 @@ def encode_pb_message(msg: Message) -> bytes:
         raise ValueError(
             f"{type(p).__name__} has no slot in the reference's oneof"
         )
+    att = (
+        _len_field(_PB_TAG_ATTEST, msg.attestation)
+        if msg.attestation
+        else b""
+    )
     return (
         _len_field(1, msg.signature)
         + _len_field(2, _timestamp_body(msg.timestamp))
         + one
+        + att
     )
 
 
@@ -217,6 +231,7 @@ def decode_pb_message(data: bytes, sender_id: str = "") -> Message:
     the stream's uuid, comm.go:46 — its envelope has no sender field).
     """
     signature = b""
+    attestation = b""
     ts = 0.0
     payload: Optional[Payload] = None
     o = 0
@@ -232,6 +247,7 @@ def decode_pb_message(data: bytes, sender_id: str = "") -> Message:
                 _PB_TAG_CATCHUP_ORD, _PB_TAG_RESHARE,
                 _PB_TAG_INGRESS_SUBMIT, _PB_TAG_INGRESS_ACK,
                 _PB_TAG_INGRESS_SUB, _PB_TAG_INGRESS_BATCH,
+                _PB_TAG_ATTEST,
             ):
                 raise ValueError(
                     f"wire type {wt} for known tag {tag} (expected LEN)"
@@ -265,12 +281,14 @@ def decode_pb_message(data: bytes, sender_id: str = "") -> Message:
             _PB_TAG_INGRESS_SUB, _PB_TAG_INGRESS_BATCH,
         ):
             payload = _parse_catchup(tag, body)
+        elif tag == _PB_TAG_ATTEST:
+            attestation = body
         # unknown LEN fields are skipped, per proto3 semantics
     if payload is None:
         raise ValueError("pb.Message carries no rbc/bba payload")
     return Message(
         sender_id=sender_id, timestamp=ts, payload=payload,
-        signature=signature,
+        signature=signature, attestation=attestation,
     )
 
 

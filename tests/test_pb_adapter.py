@@ -88,6 +88,33 @@ def test_catchup_extension_slots_roundtrip(payload):
     assert back.signature == msg.signature
 
 
+def test_attestation_trailer_roundtrips_and_stays_optional():
+    """The attested-log trailer (protocol/attest.py) rides its own
+    extension tag beside signature/timestamp: it round-trips
+    byte-exactly when armed and adds zero bytes on the baseline arm,
+    where the frame must stay identical to the pre-attestation
+    format."""
+    att = b"\x00\x00\x00\x01" + b"\x07" * 41
+    msg = Message(
+        sender_id="node9",
+        timestamp=9.5,
+        payload=RBC_P,
+        signature=b"\x03" * 32,
+        attestation=att,
+    )
+    back = decode_pb_message(encode_pb_message(msg), sender_id="node9")
+    assert back.attestation == att
+    assert back.payload == RBC_P
+    bare = Message(
+        sender_id="node9", timestamp=9.5, payload=RBC_P,
+        signature=b"\x03" * 32,
+    )
+    assert decode_pb_message(
+        encode_pb_message(bare), sender_id="node9"
+    ).attestation == b""
+    assert len(encode_pb_message(msg)) > len(encode_pb_message(bare))
+
+
 def test_malformed_frames_rejected():
     wire = encode_pb_message(
         Message(sender_id="x", timestamp=1.0, payload=BBA_P)

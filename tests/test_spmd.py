@@ -142,3 +142,48 @@ def test_lockstep_aggressive_initial_block_matches():
     assert _committed_txs(a.committed()) == _committed_txs(b.committed())
     assert a.last_stats["bba_rounds"] == b.last_stats["bba_rounds"]
     assert b.last_stats["coin_waves"] <= a.last_stats["coin_waves"]
+
+
+def test_lockstep_reconfig_boundary():
+    """Reconfig under the lockstep plane: the activation-boundary swap
+    (join + retire + fresh key material) between epochs — committed
+    history continuous, every tx exactly once, retiring node's pending
+    txs failed over to survivors."""
+    c = LockstepCluster(n=4, batch_size=16, key_seed=21)
+    for i in range(32):
+        c.submit(_tx(i))
+    pre_epochs = c.run_epochs()
+    pub0 = c.tpke.pub.master
+    # strand a tx at the retiring member: it must fail over
+    c.submit(_tx(900), node_id="node000")
+    c.reconfigure(join=["node100"], retire=["node000"])
+    assert c.ids == ["node001", "node002", "node003", "node100"]
+    assert c.config.n == 4 and c.config.f == 1
+    assert c.tpke.pub.master != pub0  # key material actually rotated
+    for i in range(32, 48):
+        c.submit(_tx(i))
+    c.run_epochs()
+    got = _committed_txs(c.committed())
+    assert got == {_tx(i) for i in range(48)} | {_tx(900)}
+    assert len(c.committed()) > pre_epochs  # epoch counter continuous
+
+
+def test_lockstep_reduced_quorum_roster():
+    """The 2f+1 trust model on the lockstep plane: n=5 carries f=2
+    (data shards = n-2f = 1) and still commits everything — the
+    quorum-mode seam reaches the batched executor through the same
+    Config arithmetic the async plane reads."""
+    from cleisthenes_tpu.config import Config
+
+    c = LockstepCluster(
+        n=5,
+        config=Config(
+            n=5, batch_size=16, attested_log=True, reduced_quorum=True
+        ),
+        key_seed=23,
+    )
+    assert c.config.f == 2 and c.config.data_shards == 1
+    for i in range(20):
+        c.submit(_tx(i))
+    c.run_epochs()
+    assert _committed_txs(c.committed()) == {_tx(i) for i in range(20)}

@@ -60,6 +60,11 @@ n, batch, epochs, seed = (
 # against depth 1 on the SAME code — only pass overrides to arms
 # whose tree knows the fields
 overrides = json.loads(os.environ.get("ABENCH_CONFIG_OVERRIDES", "{}"))
+# an arm may override the roster size itself (the ISSUE-19 trust-model
+# A/B pits a reduced-quorum n=2f+1 roster against the baseline 3f+1
+# roster at EQUAL f): an "n" in the overrides replaces the argv n for
+# that arm instead of colliding with it in the Config call
+n = int(overrides.pop("n", n))
 # the production shape: work pre-submitted, auto-propose on, ONE
 # net.run chains every epoch back to back — the shape where cross-
 # epoch pipelining (old or two-frontier) is actually reachable.

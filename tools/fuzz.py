@@ -104,6 +104,7 @@ def sample_schedule(
     wan: bool = False,
     wan_profile: Optional[str] = None,
     ingress: bool = False,
+    reduced: bool = False,
 ) -> dict:
     """One composite fault schedule, a pure function of ``seed``.
 
@@ -137,18 +138,53 @@ def sample_schedule(
     — pool capacity, per-client cap, client population, duplicate
     resubmit mix — drawn from the seed LAST of all (after the WAN
     key, the same append-LAST rule), so every older band's seed
-    stream stays bit-identical."""
+    stream stays bit-identical.
+
+    ``reduced=True`` (the reduced-quorum band, ISSUE 19) samples the
+    attested 2f+1 trust model instead: the roster is drawn from the
+    n >= 2f+1 shapes {3, 5, 7} at FULL fault budget f = (n-1)//2 —
+    rosters the baseline 3f+1 arithmetic cannot carry — with
+    ``Config.attested_log`` + ``Config.reduced_quorum`` mounted.  The
+    coalition is restricted to wire-level + crash/partition faults
+    plus the Equivocator, because that is the model's contract: the
+    reduced quorum's intersection argument assumes equivocation is
+    EXCLUDED (the attested log converts it to detectable omission),
+    not that arbitrary semantic lies are tolerated past n/3.  This
+    band is a NEW seed stream (n and f are drawn differently by
+    construction); every reduced=False band's stream is untouched."""
     rng = random.Random(seed)
-    f = (n - 1) // 3
+    if reduced:
+        n = rng.choice((3, 5, 7))
+        f = (n - 1) // 2
+    else:
+        f = (n - 1) // 3
     ids = [f"node{i:03d}" for i in range(n)]
     bad = sorted(rng.sample(ids, f)) if f else []
 
     behaviors: List[dict] = []
-    for node in bad:
-        for kind in rng.sample(_SEMANTIC_KINDS, rng.randrange(0, 3)):
-            behaviors.append(
-                {"kind": kind, "node": node, "seed": rng.randrange(1 << 16)}
-            )
+    if reduced:
+        # the only semantic behavior the band mounts is the attack
+        # the attested log exists to kill; its lies must degrade to
+        # omission (detected + excluded), never fork honest ledgers
+        for node in bad:
+            if rng.random() < 0.5:
+                behaviors.append(
+                    {
+                        "kind": "equivocator",
+                        "node": node,
+                        "seed": rng.randrange(1 << 16),
+                    }
+                )
+    else:
+        for node in bad:
+            for kind in rng.sample(_SEMANTIC_KINDS, rng.randrange(0, 3)):
+                behaviors.append(
+                    {
+                        "kind": kind,
+                        "node": node,
+                        "seed": rng.randrange(1 << 16),
+                    }
+                )
 
     wire: List[dict] = []
     for stage, argspec in _WIRE_STAGES:
@@ -252,6 +288,10 @@ def sample_schedule(
         out["wan_profile"] = wan_profile
     if ingress_cfg is not None:
         out["ingress"] = ingress_cfg
+    if reduced:
+        # one key implies both flags: Config enforces that the
+        # reduced quorum never mounts without the attested log
+        out["reduced"] = True
     return out
 
 
@@ -280,11 +320,18 @@ def _build_cluster(schedule: dict, trace: bool) -> SimulatedCluster:
     # historical schedules (capacity 0 keeps the direct
     # add_transaction path)
     ing = schedule.get("ingress")
+    # reduced-quorum band (ISSUE 19): the schedule key mounts the
+    # attested sender log AND the n-f quorum arithmetic together
+    # (Config rejects the latter without the former); Config
+    # re-derives f = (n-1)//2 to match the schedule's coalition size
+    red = bool(schedule.get("reduced"))
     cfg = Config(
         n=schedule["n"],
         batch_size=schedule["batch_size"],
         seed=schedule["seed"],
         trace=trace,
+        attested_log=red,
+        reduced_quorum=red,
         # schedules may pin the routing arm: wave_routing drains a
         # whole wave before any handler runs, so the scalar arm's
         # finer per-message interleavings are a schedule space of
@@ -582,6 +629,68 @@ def _ingress_audit(
     return None
 
 
+def _reduced_audit(
+    cluster, bad: List[str], rounds_used: int
+) -> Optional[dict]:
+    """The reduced-quorum band's terminal invariants (ISSUE 19).
+
+    1. No false accusations: counter-fork evidence only ever
+       accumulates against coalition members — an honest sender's
+       vault never refuses, so an accusation of one would mean forged
+       evidence (or an honest equivocation, either being a bug).
+    2. Detection: every coalition equivocator whose vault actually
+       refused a forked slot is in the evidence directory — its
+       self-incriminating refused=1 frames reached at least one
+       honest receiver and were recorded (the coalition's wire
+       faults can drop SOME frames, but a lie the protocol plane
+       kept retrying cannot stay invisible for a whole run).
+    3. Exactly-once settle: on the reference honest ledger no tx
+       settles twice — the n-f quorum arithmetic must not weaken the
+       dedup/commit rule at n = 2f+1.
+    """
+    dirc = cluster.attest_dir
+    false_accused = sorted(dirc.accused - set(bad))
+    if false_accused:
+        return {
+            "invariant": "attest_no_false_accusation",
+            "detail": f"honest nodes accused of forks: {false_accused}",
+            "round": rounds_used,
+        }
+    undetected = sorted(
+        nid
+        for nid in bad
+        if getattr(cluster.auths.get(nid), "vault", None) is not None
+        and cluster.auths[nid].vault.refusals > 0
+        and nid not in dirc.accused
+    )
+    if undetected:
+        return {
+            "invariant": "attest_fork_detection",
+            "detail": (
+                f"equivocators forked attested slots undetected: "
+                f"{undetected}"
+            ),
+            "round": rounds_used,
+        }
+    ref = next(
+        cluster.nodes[nid]
+        for nid in sorted(cluster.nodes)
+        if nid not in bad
+    )
+    counts: Dict[bytes, int] = {}
+    for batch in ref.committed_batches:
+        for tx in batch.tx_list():
+            counts[tx] = counts.get(tx, 0) + 1
+    dups = sorted(tx for tx, c in counts.items() if c > 1)
+    if dups:
+        return {
+            "invariant": "reduced_exact_once",
+            "detail": f"txs settled more than once: {dups[:4]}",
+            "round": rounds_used,
+        }
+    return None
+
+
 def run_schedule(
     schedule: dict, trace_path: Optional[str] = None
 ) -> Optional[dict]:
@@ -673,6 +782,13 @@ def run_schedule(
                     "round": rounds_used,
                 }
                 break
+    if violation is None and schedule.get("reduced"):
+        # the band's extra terminal invariants: fork evidence only
+        # against the coalition, every actual equivocation detected,
+        # settle-exactly-once at n = 2f+1
+        violation = _reduced_audit(
+            cluster, schedule["bad"], rounds_used
+        )
     if trace_path is not None:
         cluster.write_trace(trace_path)
     return violation
@@ -776,6 +892,7 @@ def fuzz_seeds(
     wan: bool = False,
     wan_profile: Optional[str] = None,
     ingress: bool = False,
+    reduced: bool = False,
 ) -> int:
     """Run a schedule per seed; on the first violation, shrink it and
     emit a repro file plus (by default) a flight-recorder trace
@@ -793,6 +910,7 @@ def fuzz_seeds(
             wan=wan,
             wan_profile=wan_profile,
             ingress=ingress,
+            reduced=reduced,
         )
         violation = run_schedule(schedule)
         if violation is None:
@@ -858,6 +976,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         "settle-exactly-once invariant",
     )
     ap.add_argument(
+        "--reduced-quorum",
+        action="store_true",
+        help="reduced-quorum band (ISSUE 19): attested sender log + "
+        "n-f quorum arithmetic on 2f+1-shaped rosters drawn from "
+        "{3,5,7} at f=(n-1)//2, coalition restricted to wire/crash "
+        "faults + the Equivocator; gates the fork-evidence, "
+        "no-false-accusation and settle-exactly-once invariants",
+    )
+    ap.add_argument(
         "--show", action="store_true", help="print the schedule, no run"
     )
     ap.add_argument("--repro", help="replay a repro file")
@@ -900,6 +1027,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 wan=wan,
                 wan_profile=args.wan_profile,
                 ingress=args.ingress,
+                reduced=args.reduced_quorum,
             )
             json.dump(schedule, sys.stdout, indent=2, sort_keys=True)
             print()
@@ -915,6 +1043,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         wan=wan,
         wan_profile=args.wan_profile,
         ingress=args.ingress,
+        reduced=args.reduced_quorum,
     )
 
 

@@ -302,6 +302,15 @@ def run_sample(
             # them what every per-epoch dispatch counter MEANS — so
             # runs gate only against same-depth trend records
             "pipeline_depth": int(cfg.pipeline_depth),
+            # the trust-model arms (ISSUE 19): the attested sender
+            # log adds a per-frame stamp+verify to every MAC, and the
+            # reduced-quorum mode changes the quorum arithmetic the
+            # epochs wait on (f=(n-1)//2 instead of f=(n-1)//3) —
+            # both change what the epoch windows and sign/verify
+            # counters MEAN, so runs gate only against same-mode
+            # trend records
+            "attested_log": bool(cfg.attested_log),
+            "reduced_quorum": bool(cfg.reduced_quorum),
             # the ingress mini-load's shape changes what the
             # submit->ordered p50 and the eviction count MEAN —
             # reshaping it re-keys the trend (run --reset after an
