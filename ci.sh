@@ -185,6 +185,16 @@ JAX_PLATFORMS=cpu python -m tools.fuzz --seeds 0:20 --ingress \
 # (appended LAST, extending the historical stream)
 JAX_PLATFORMS=cpu python -m tools.fuzz --seeds 0:20 \
     --reduced-quorum --out "$FUZZ_OUT"
+# lane shard-out band (ISSUE 20): Config.lanes drawn from {2,3,4}
+# per seed (appended LAST, extending the historical stream) — S
+# parallel HBBFT lanes over one roster with hash-partitioned
+# admission and the deterministic cross-lane total-order merge —
+# gating merge-determinism (honest merged orders byte-identical),
+# cross-lane settle-exactly-once and the per-lane two-frontier
+# invariants (the 200-seed deep sweep rides the slow tier,
+# tests/test_fuzz.py::test_fuzz_lanes_deep_sweep)
+JAX_PLATFORMS=cpu python -m tools.fuzz --seeds 0:20 --lanes \
+    --out "$FUZZ_OUT"
 rm -rf "$FUZZ_OUT"
 
 if [[ "${CI_FAST:-0}" == "1" ]]; then

@@ -36,6 +36,10 @@ ARM_FLAGS = (
     "egress_columnar",
     "attested_log",
     "reduced_quorum",
+    # int-valued arm: lanes=1 is the byte-equivalence baseline arm,
+    # lanes>1 the shard-out fast path (ARM001 accepts int flags whose
+    # tests pin >= 2 distinct values; see tools/staticcheck).
+    "lanes",
 )
 
 DEFAULT_DIAL_TIMEOUT_S = 3.0  # reference comm.go:107-109
@@ -46,6 +50,11 @@ DEFAULT_DIAL_TIMEOUT_S = 3.0  # reference comm.go:107-109
 # in-flight epoch past the horizon could not be delivered to a peer
 # at the same frontier.
 MAX_PIPELINE_DEPTH = 8
+# Horizontal shard-out (Config.lanes): at most this many parallel
+# consensus lanes over one roster.  The cap bounds the per-node state
+# multiplier (S lane instances share one hub/coalescer/WAL) and keeps
+# the lane id in a u32 wire field with headroom to spare.
+MAX_LANES = 8
 DEFAULT_CHANNEL_CAPACITY = 200  # reference conn.go:60-61 (out/read chans)
 # Self-healing dial layer (transport/host.py): first retry delay and
 # the cap of the exponential backoff.  The reference redials never
@@ -333,6 +342,24 @@ class Config:
     # False arm's arithmetic is bit-identical to the historical
     # thresholds.  Sound only together with attested_log (enforced).
     reduced_quorum: bool = False
+    # --- horizontal shard-out (ISSUE 20) --------------------------
+    # lanes = S runs S independent HBBFT lane instances over the SAME
+    # validator set, transports and roster schedule.  Admission
+    # tx-hash-partitions across lanes (core.merge.lane_of: seeded
+    # sha256(seed || digest) % S, node- and PYTHONHASHSEED-identical);
+    # each lane keeps its own epoch frontiers and lane-tagged WAL
+    # record stream, and the settled frontiers merge into ONE
+    # deterministic total order (core.merge.MergeCursor: epoch-major,
+    # lane-minor — a pure function of the committed bytes, so honest
+    # nodes' merged orders are byte-identical).  Lane traffic rides
+    # the SAME coalescer flushes, delivery waves and hub columns as
+    # lane 0 (LanePayload wire framing + lane-qualified hub scopes),
+    # so S lanes' crypto amortizes into the same native dispatches
+    # instead of multiplying them.  1 (default) is byte-identical to
+    # the pre-lane build: no LanePayload ever hits the wire, no lane
+    # records hit the WAL.  Dynamic membership (RECONFIG) is not
+    # supported at lanes > 1.
+    lanes: int = 1
 
     def __post_init__(self) -> None:
         if self.n < 1:
@@ -452,6 +479,12 @@ class Config:
             raise ValueError(
                 f"ingress_port={self.ingress_port} must be None or "
                 "0..65535"
+            )
+        if not (1 <= self.lanes <= MAX_LANES):
+            raise ValueError(
+                f"lanes={self.lanes} must be 1..{MAX_LANES} (S parallel "
+                "consensus lanes over one roster; 1 = single-lane "
+                "pre-shard-out behavior)"
             )
         if self.mesh_shape is not None:
             from cleisthenes_tpu.parallel.mesh import validate_mesh_shape

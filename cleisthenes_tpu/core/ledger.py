@@ -62,6 +62,17 @@ _MAGIC = b"CLOG"
 _MAGIC_CKPT = b"CCKP"
 _MAGIC_ORD = b"COrd"
 _MAGIC_RCFG = b"RCFG"
+# Lane-tagged twins (horizontal shard-out, ISSUE 20): lanes > 0 of an
+# S-lane node share ONE log file with lane 0, appending records whose
+# body is ``u32 lane | <the lane-agnostic body>`` under these magics.
+# Lane 0 keeps the bare magics above, so a lanes=1 log — and lane 0's
+# stream inside an S-lane log — stays byte-identical to the pre-lane
+# format, and the bare replay()/replay_ordered() iterators (which
+# filter by magic) never see lane traffic.  Per-lane recovery goes
+# through ``BatchLog.lane_view(lane)``.
+_MAGIC_LANE = b"LCLG"
+_MAGIC_LANE_CKPT = b"LCKP"
+_MAGIC_LANE_ORD = b"LOrd"
 
 
 def encode_batch_body(epoch: int, batch: Batch) -> bytes:
@@ -275,9 +286,21 @@ def _decode_body(body: bytes) -> Tuple[int, Batch]:
     return epoch, Batch(contributions=contributions)
 
 
+def _lane_body(lane: int, body: bytes) -> bytes:
+    return struct.pack(">I", lane) + body
+
+
+def _split_lane_body(body: bytes) -> Tuple[int, bytes]:
+    if len(body) < 4:
+        raise ValueError("lane record body too short")
+    (lane,) = struct.unpack_from(">I", body, 0)
+    return lane, body[4:]
+
+
 @guarded_by(
     "_lock", "_fh", "_last_epoch", "_last_checkpoint",
-    "_last_ordered_epoch",
+    "_last_ordered_epoch", "_lane_last_epoch", "_lane_last_ordered",
+    "_lane_last_checkpoint",
 )
 class BatchLog:
     """Append-only durable log of committed batches.
@@ -294,6 +317,14 @@ class BatchLog:
         self._last_epoch: Optional[int] = None
         self._last_checkpoint: Optional[Tuple[int, List[Set[bytes]]]] = None
         self._last_ordered_epoch: Optional[int] = None
+        # per-lane recovered state for lanes > 0 (lane 0 uses the bare
+        # fields above); populated by _recover_locked and the lane
+        # append paths, read through lane_view()
+        self._lane_last_epoch: Dict[int, int] = {}
+        self._lane_last_ordered: Dict[int, int] = {}
+        self._lane_last_checkpoint: Dict[
+            int, Tuple[int, List[Set[bytes]]]
+        ] = {}
         # flight recorder (utils/trace.py), set by the owning node
         # when Config.trace is on: every append/checkpoint records a
         # "ledger" span (write+flush+fsync cost is a real commit-path
@@ -320,6 +351,9 @@ class BatchLog:
                 and magic != _MAGIC_CKPT
                 and magic != _MAGIC_ORD
                 and magic != _MAGIC_RCFG
+                and magic != _MAGIC_LANE
+                and magic != _MAGIC_LANE_ORD
+                and magic != _MAGIC_LANE_CKPT
             ):
                 return
             (body_len,) = struct.unpack_from(">I", data, off + 4)
@@ -337,6 +371,12 @@ class BatchLog:
                     decode_ordered_body(body)
                 elif magic == _MAGIC_RCFG:
                     decode_reconfig_body(body)
+                elif magic == _MAGIC_LANE:
+                    _decode_body(_split_lane_body(body)[1])
+                elif magic == _MAGIC_LANE_ORD:
+                    decode_ordered_body(_split_lane_body(body)[1])
+                elif magic == _MAGIC_LANE_CKPT:
+                    _decode_checkpoint_body(_split_lane_body(body)[1])
                 else:
                     _decode_checkpoint_body(body)
             except (ValueError, struct.error, UnicodeDecodeError):
@@ -362,6 +402,19 @@ class BatchLog:
             elif magic == _MAGIC_CKPT:
                 epoch, history = _decode_checkpoint_body(body)
                 self._last_checkpoint = (epoch, history)
+            elif magic == _MAGIC_LANE:
+                lane, inner = _split_lane_body(body)
+                self._lane_last_epoch[lane], _ = _decode_body(inner)
+            elif magic == _MAGIC_LANE_ORD:
+                lane, inner = _split_lane_body(body)
+                (self._lane_last_ordered[lane],) = struct.unpack_from(
+                    ">Q", inner, 0
+                )
+            elif magic == _MAGIC_LANE_CKPT:
+                lane, inner = _split_lane_body(body)
+                self._lane_last_checkpoint[lane] = _decode_checkpoint_body(
+                    inner
+                )
             # RCFG records are consumed via replay_reconfigs()
             good_end = end
         if good_end < len(data):  # torn/corrupt tail: drop it
@@ -520,6 +573,158 @@ class BatchLog:
     def close(self) -> None:
         with self._lock:
             self._fh.close()
+
+    def lane_view(self, lane: int) -> "_LaneLog":
+        """The per-lane facade of this log (horizontal shard-out):
+        lane 0 is the log itself — its records keep the bare magics,
+        byte-identical to a single-lane build — and lanes > 0 get a
+        delegating view that appends/replays ``u32 lane``-prefixed
+        lane-magic records in the SAME file.  Restart recovery
+        re-enters every lane's ordered-unsettled window independently
+        by replaying its own view."""
+        if lane == 0:
+            return self
+        return _LaneLog(self, lane)
+
+
+class _LaneLog:
+    """BatchLog facade for one lane > 0: the batch_log API surface the
+    protocol plane consumes, with every record lane-tagged and every
+    replay/last-* read filtered to this lane.  Shares the parent's
+    file handle, lock and trace recorder; ``close()`` is a no-op (the
+    lane-0 owner closes the file)."""
+
+    __slots__ = ("_log", "lane")
+
+    def __init__(self, log: BatchLog, lane: int):
+        if lane < 1:
+            raise ValueError(f"lane view lane={lane} must be >= 1")
+        self._log = log
+        self.lane = lane
+
+    @property
+    def path(self) -> str:
+        return self._log.path
+
+    @property
+    def fsync(self) -> bool:
+        return self._log.fsync
+
+    @property
+    def trace(self):
+        return self._log.trace
+
+    @trace.setter
+    def trace(self, recorder) -> None:
+        # lanes share the node's recorder; the primary installs it
+        # once on the parent and lane installs are idempotent aliases
+        self._log.trace = recorder
+
+    def append(self, epoch: int, batch: Batch) -> None:
+        log = self._log
+        rec = _frame_record(
+            _MAGIC_LANE, _lane_body(self.lane, _encode_body(epoch, batch))
+        )
+        tr = log.trace
+        t0 = 0.0 if tr is None else tr.now()
+        with log._lock:
+            log._append_record_locked(rec)
+            log._lane_last_epoch[self.lane] = epoch
+        if tr is not None:
+            tr.complete(
+                "ledger", "wal_append", t0, epoch=epoch, bytes=len(rec),
+                lane=self.lane,
+            )
+
+    def append_ordered(self, epoch: int, output: Dict[str, bytes]) -> bytes:
+        body = encode_ordered_body(epoch, output)
+        self.append_ordered_body(epoch, body)
+        return body
+
+    def append_ordered_body(self, epoch: int, body: bytes) -> None:
+        log = self._log
+        rec = _frame_record(_MAGIC_LANE_ORD, _lane_body(self.lane, body))
+        tr = log.trace
+        t0 = 0.0 if tr is None else tr.now()
+        with log._lock:
+            log._append_record_locked(rec)
+            log._lane_last_ordered[self.lane] = epoch
+        if tr is not None:
+            tr.complete(
+                "ledger", "wal_ordered", t0, epoch=epoch, bytes=len(rec),
+                lane=self.lane,
+            )
+
+    def append_checkpoint(
+        self, epoch: int, history: Sequence[Set[bytes]]
+    ) -> None:
+        log = self._log
+        rec = _frame_record(
+            _MAGIC_LANE_CKPT,
+            _lane_body(self.lane, _encode_checkpoint_body(epoch, history)),
+        )
+        tr = log.trace
+        t0 = 0.0 if tr is None else tr.now()
+        with log._lock:
+            log._append_record_locked(rec)
+            log._lane_last_checkpoint[self.lane] = (
+                epoch,
+                [set(s) for s in history],
+            )
+        if tr is not None:
+            tr.complete(
+                "ledger", "wal_checkpoint", t0, epoch=epoch,
+                bytes=len(rec), lane=self.lane,
+            )
+
+    def append_reconfig(self, *args, **kwargs) -> None:
+        raise NotImplementedError(
+            "dynamic membership is not supported at lanes > 1 "
+            "(Config.lanes docs): no RCFG records in lane streams"
+        )
+
+    def replay(self) -> Iterator[Tuple[int, Batch]]:
+        with open(self._log.path, "rb") as fh:
+            data = fh.read()
+        for _end, magic, body in self._log._scan(data):
+            if magic == _MAGIC_LANE:
+                lane, inner = _split_lane_body(body)
+                if lane == self.lane:
+                    yield _decode_body(inner)
+
+    def replay_ordered(self) -> Iterator[Tuple[int, bytes]]:
+        with open(self._log.path, "rb") as fh:
+            data = fh.read()
+        for _end, magic, body in self._log._scan(data):
+            if magic == _MAGIC_LANE_ORD:
+                lane, inner = _split_lane_body(body)
+                if lane == self.lane:
+                    (epoch,) = struct.unpack_from(">Q", inner, 0)
+                    yield epoch, inner
+
+    def replay_reconfigs(self):
+        return iter(())  # lanes never carry roster switches
+
+    @property
+    def last_epoch(self) -> Optional[int]:
+        log = self._log
+        with log._lock:
+            return log._lane_last_epoch.get(self.lane)
+
+    @property
+    def last_ordered_epoch(self) -> Optional[int]:
+        log = self._log
+        with log._lock:
+            return log._lane_last_ordered.get(self.lane)
+
+    @property
+    def last_checkpoint(self) -> Optional[Tuple[int, List[Set[bytes]]]]:
+        log = self._log
+        with log._lock:
+            return log._lane_last_checkpoint.get(self.lane)
+
+    def close(self) -> None:
+        pass  # the lane-0 owner closes the shared file
 
 
 __all__ = [

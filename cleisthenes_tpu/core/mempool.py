@@ -44,6 +44,7 @@ import hashlib
 import heapq
 from typing import Callable, Deque, Dict, Iterable, List, NamedTuple, Optional, Tuple
 
+from cleisthenes_tpu.core.merge import lane_of
 from cleisthenes_tpu.utils.determinism import guarded_by
 from cleisthenes_tpu.utils.lockcheck import new_lock
 
@@ -71,9 +72,11 @@ class Admission(NamedTuple):
 
 
 class _Entry:
-    __slots__ = ("digest", "client_id", "fee", "tb", "seq", "tx", "drained")
+    __slots__ = (
+        "digest", "client_id", "fee", "tb", "seq", "tx", "drained", "lane",
+    )
 
-    def __init__(self, digest, client_id, fee, tb, seq, tx):
+    def __init__(self, digest, client_id, fee, tb, seq, tx, lane=0):
         self.digest = digest
         self.client_id = client_id
         self.fee = fee
@@ -81,6 +84,7 @@ class _Entry:
         self.seq = seq
         self.tx = tx
         self.drained = False
+        self.lane = lane
 
 
 def tx_digest(tx: bytes) -> bytes:
@@ -93,9 +97,10 @@ def tx_digest(tx: bytes) -> bytes:
     "_live",
     "_seen",
     "_by_client",
-    "_drain_heap",
+    "_drain_heaps",
     "_evict_heap",
     "_seq",
+    "_lane_pending",
 )
 class Mempool:
     """One node's fee-priority admission pool.  Thread-safe: admit()
@@ -111,6 +116,7 @@ class Mempool:
         retry_after_ms: int = 100,
         seed: int = 0,
         on_evict: Optional[Callable[[bytes, str], None]] = None,
+        lanes: int = 1,
     ) -> None:
         if capacity < 1:
             raise ValueError(f"capacity={capacity} must be >= 1")
@@ -119,10 +125,14 @@ class Mempool:
                 f"client_cap={client_cap} seen_cap={seen_cap}: both "
                 "must be >= 1"
             )
+        if lanes < 1:
+            raise ValueError(f"lanes={lanes} must be >= 1")
         self.capacity = capacity
         self.client_cap = client_cap
         self.seen_cap = seen_cap
         self.retry_after_ms = retry_after_ms
+        self.lanes = lanes
+        self._seed = seed
         self._tb_seed = seed.to_bytes(8, "big", signed=True)
         self._on_evict = on_evict
         self._lock = new_lock()
@@ -134,10 +144,21 @@ class Mempool:
         # client -> live (pending + in-flight) entry count
         self._by_client: Dict[str, int] = {}
         # lazy-deletion heaps over PENDING entries; stale slots are
-        # skipped at pop when the digest is gone or already drained
-        self._drain_heap: List[Tuple[int, bytes, int, bytes]] = []
+        # skipped at pop when the digest is gone or already drained.
+        # One drain heap PER LANE (horizontal shard-out): admission
+        # routes each entry to lane_of(seed, digest, lanes) and each
+        # lane's batch selection drains only its own heap, so the
+        # per-lane ledgers stay disjoint.  lanes=1 keeps the single
+        # heap and bit-identical drain order.  Eviction stays global
+        # (the lowest-priority pending entry across all lanes).
+        self._drain_heaps: List[List[Tuple[int, bytes, int, bytes]]] = [
+            [] for _ in range(lanes)
+        ]
         self._evict_heap: List[Tuple[int, bytes, int, bytes]] = []
         self._seq = 0
+        # per-lane gauges/fill counters (partition-skew reporting)
+        self._lane_pending: List[int] = [0] * lanes
+        self.lane_admitted: List[int] = [0] * lanes
         # lifetime counters (the ingress metrics block reads these)
         self.submitted = 0
         self.admitted = 0
@@ -206,19 +227,26 @@ class Mempool:
                     )
                 self._evict_locked(victim)
             self._seq += 1
-            e = _Entry(digest, client_id, fee, tb, self._seq, tx)
+            lane = (
+                lane_of(self._seed, digest, self.lanes)
+                if self.lanes > 1
+                else 0
+            )
+            e = _Entry(digest, client_id, fee, tb, self._seq, tx, lane)
             self._live[digest] = e
             self._by_client[client_id] = (
                 self._by_client.get(client_id, 0) + 1
             )
             self._remember_locked(digest)
             heapq.heappush(
-                self._drain_heap, (-fee, tb, e.seq, digest)
+                self._drain_heaps[lane], (-fee, tb, e.seq, digest)
             )
             heapq.heappush(
                 self._evict_heap, (fee, self._inv(tb), -e.seq, digest)
             )
             self.admitted += 1
+            self.lane_admitted[lane] += 1
+            self._lane_pending[lane] += 1
             return Admission(OK, 0, "", digest)
 
     def _remember_locked(self, digest: bytes) -> None:
@@ -244,6 +272,7 @@ class Mempool:
     def _evict_locked(self, e: "_Entry") -> None:
         heapq.heappop(self._evict_heap)
         del self._live[e.digest]
+        self._lane_pending[e.lane] -= 1
         self._dec_client_locked(e.client_id)
         # an evicted digest stays in the seen-ring: a resubmit of it
         # acks DUPLICATE until the ring forgets it, which is the
@@ -261,21 +290,25 @@ class Mempool:
 
     # -- the TxQueue seam ----------------------------------------------
 
-    def drain_into(self, queue, max_n: int) -> int:
-        """Move up to ``max_n`` highest-priority pending txs into the
-        FIFO TxQueue ahead of batch selection.  Drained entries stay
-        live (in flight) for client-cap accounting and the
-        settles-exactly-once ledger until mark_settled retires them."""
+    def drain_into(self, queue, max_n: int, lane: int = 0) -> int:
+        """Move up to ``max_n`` highest-priority pending txs of
+        ``lane`` into the FIFO TxQueue ahead of batch selection (the
+        single-lane build always drains lane 0, the only heap).
+        Drained entries stay live (in flight) for client-cap
+        accounting and the settles-exactly-once ledger until
+        mark_settled retires them."""
         moved = 0
         with self._lock:
-            while moved < max_n and self._drain_heap:
-                neg_fee, tb, seq, digest = self._drain_heap[0]
+            heap = self._drain_heaps[lane]
+            while moved < max_n and heap:
+                neg_fee, tb, seq, digest = heap[0]
                 e = self._live.get(digest)
                 if e is None or e.drained or e.seq != seq:
-                    heapq.heappop(self._drain_heap)
+                    heapq.heappop(heap)
                     continue
-                heapq.heappop(self._drain_heap)
+                heapq.heappop(heap)
                 e.drained = True
+                self._lane_pending[e.lane] -= 1
                 queue.push(e.tx)
                 moved += 1
         return moved
@@ -291,14 +324,28 @@ class Mempool:
                 digest = tx_digest(tx)
                 e = self._live.pop(digest, None)
                 if e is not None:
+                    if not e.drained:
+                        # settled from a PEER's proposal while still
+                        # pending here: retire the lane gauge too
+                        self._lane_pending[e.lane] -= 1
                     self._dec_client_locked(e.client_id)
 
     # -- introspection --------------------------------------------------
 
-    def pending_count(self) -> int:
-        """Entries admitted but not yet drained into the TxQueue."""
+    def pending_count(self, lane: Optional[int] = None) -> int:
+        """Entries admitted but not yet drained into the TxQueue
+        (optionally of one lane only — the lane's propose gate)."""
         with self._lock:
+            if lane is not None:
+                return self._lane_pending[lane]
             return sum(1 for e in self._live.values() if not e.drained)
+
+    def lane_fill(self) -> List[int]:
+        """Lifetime admissions per lane — the partition-skew witness
+        (loadgen reports max/min over this; snapshot()["lanes"]
+        carries the spread)."""
+        with self._lock:
+            return list(self.lane_admitted)
 
     def inflight_count(self) -> int:
         """Entries drained into the TxQueue but not yet settled."""

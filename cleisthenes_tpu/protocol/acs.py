@@ -217,11 +217,21 @@ class ACS:
         coin_issue_sink=None,
         trace=None,
         metrics=None,
+        scope=None,
     ) -> None:
         self.n = config.n
         self.f = config.f
         self.epoch = epoch
         self.owner = owner
+        # the hub-scope owner key (defaults to ``owner``): lane
+        # shard-out (Config.lanes) runs S sibling HoneyBadger
+        # instances per node against ONE shared hub, and each lane's
+        # epoch GC must only drop ITS OWN epoch's clients — so lanes
+        # > 0 qualify the scope with the lane id while ``owner``
+        # keeps its protocol meaning (the member id this ACS
+        # proposes under).  Lane 0 passes scope == owner, keeping
+        # the single-lane scope keys byte-identical.
+        self.scope = owner if scope is None else scope
         self.members: List[str] = sorted(member_ids)
         self._member_set = frozenset(self.members)
         # fn(epoch, {proposer: value}) fired exactly once
@@ -267,6 +277,7 @@ class ACS:
                 index=index,
                 trace=trace,
                 metrics=metrics,
+                scope=self.scope,
             )
             rbc.on_deliver = self._on_rbc_deliver
             self.rbcs[proposer] = rbc
@@ -285,6 +296,7 @@ class ACS:
                 coin_issue_sink=coin_issue_sink,
                 trace=trace,
                 metrics=metrics,
+                scope=self.scope,
             )
             bba.on_decide = self._on_bba_decide
             self.bbas[proposer] = bba

@@ -113,6 +113,7 @@ class BBA:
         coin_issue_sink: Optional[Callable] = None,
         trace=None,
         metrics=None,
+        scope=None,
     ) -> None:
         self.n = config.n
         self.f = config.f
@@ -152,7 +153,8 @@ class BBA:
                 )
             )
         self.hub = hub
-        self.hub.register((owner, epoch), self)  # see rbc.py note
+        # see rbc.py note: lane shard-out qualifies scope per lane
+        self.hub.register((owner if scope is None else scope, epoch), self)
         # flight recorder (None = tracing off; utils/trace.py)
         self.trace = trace
         # owner-node metrics (None in standalone unit tests): only the

@@ -75,12 +75,24 @@ def run_until_drained(
         if on_quiescence is not None:
             on_quiescence(r)
         if all(
-            hb.pending_tx_count() == 0
+            hb.pending_tx_count() == 0 and _lanes_merged(hb)
             for nid, hb in nodes.items()
             if nid not in skip
         ):
             return r + 1
     return max_rounds
+
+
+def _lanes_merged(hb: HoneyBadger) -> bool:
+    """Quiescence extension for lane shard-out: every settled lane
+    epoch has also merge-emitted (no lane is epochs ahead of a
+    sibling, parking merged slots).  Always True at lanes=1; the
+    lockstep drive closes any gap within a few more rounds."""
+    if hb._merge is None:
+        return True
+    return hb.merged_settled_frontier == sum(
+        len(lane.committed_batches) for lane in hb.lanes
+    )
 
 
 class SimulatedCluster:
@@ -336,20 +348,26 @@ class SimulatedCluster:
     def committed(self, node_id: Optional[str] = None) -> List[Batch]:
         return list(self.nodes[node_id or self.ids[0]].committed_batches)
 
+    def merged(self, node_id: Optional[str] = None) -> List[Batch]:
+        """The MERGED total order (== committed() at lanes=1): the
+        cross-lane deterministic ledger every client reads."""
+        return list(self.nodes[node_id or self.ids[0]].merged_batches)
+
     def assert_agreement(self, skip: Sequence[str] = ()) -> int:
-        """Every live node committed the identical batch history;
-        returns the common depth."""
+        """Every live node committed the identical batch history —
+        compared over the MERGED total order, which IS the per-lane
+        committed history at lanes=1; returns the common depth."""
         live = {
             nid: hb for nid, hb in self.nodes.items() if nid not in skip
         }
-        depth = min(len(hb.committed_batches) for hb in live.values())
+        depth = min(len(hb.merged_batches) for hb in live.values())
         assert depth > 0, "no common committed epoch"
         for e in range(depth):
             lists = {
-                tuple(hb.committed_batches[e].tx_list())
+                tuple(hb.merged_batches[e].tx_list())
                 for hb in live.values()
             }
-            assert len(lists) == 1, f"fork at epoch {e}"
+            assert len(lists) == 1, f"fork at merged slot {e}"
         return depth
 
     def _make_auth(self, nid: str, mac_keys) -> HmacAuthenticator:
@@ -646,11 +664,11 @@ class SimulatedCluster:
         (positive gaps only) — the in-proc peer-lag signal: a crashed
         or starved node stops advancing and shows up here on every
         healthy node's watchdog."""
-        own = self.nodes[node_id].epoch
+        own = self.nodes[node_id].merged_ordered_frontier
         return {
-            nid: own - hb.epoch
+            nid: own - hb.merged_ordered_frontier
             for nid, hb in self.nodes.items()
-            if nid != node_id and own - hb.epoch > 0
+            if nid != node_id and own - hb.merged_ordered_frontier > 0
         }
 
     def health(self) -> Dict[str, object]:

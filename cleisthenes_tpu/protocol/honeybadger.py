@@ -72,6 +72,7 @@ from cleisthenes_tpu.transport.message import (
     DecShareBatchPayload,
     DecSharePayload,
     EchoBatchPayload,
+    LanePayload,
     Message,
     RbcPayload,
     ReadyBatchPayload,
@@ -337,6 +338,8 @@ def _logical_count(p) -> int:
     carries one vote/share PER INSTANCE, and msgs_in counts logical
     messages so throughput numbers stay comparable across the
     scalar->columnar wire change."""
+    if p.__class__ is LanePayload:
+        p = p.inner  # lane framing is transport plumbing, not a message
     proposers = getattr(p, "proposers", None)
     return len(proposers) if proposers is not None else 1
 
@@ -465,6 +468,38 @@ class _CountingBroadcaster:
         self._inner.send_to(member_id, payload)
 
 
+class _LaneTagger:
+    """Outbound lane framing for sibling lanes (Config.lanes > 1).
+
+    A lane-k (k > 0) HoneyBadger's protocol payloads wrap in
+    ``LanePayload(k, inner)`` BEFORE entering the node's ONE shared
+    CoalescingBroadcaster, so all S lanes' traffic of a turn rides the
+    same per-receiver bundle (one flush, one envelope per receiver per
+    wave — the dispatch-flatness requirement).  The coalescer's
+    columnar merge understands the tag: runs of same-lane same-kind
+    payloads still merge into one lane-wrapped column.  Lane 0 never
+    wraps (its wire frames stay byte-identical to the single-lane
+    build), and the receiver's demux routes lane k frames into its
+    lane-k sibling."""
+
+    __slots__ = ("_inner", "_lane")
+
+    def __init__(self, inner, lane: int) -> None:
+        self._inner = inner
+        self._lane = lane
+
+    def broadcast(self, payload) -> None:
+        self._inner.broadcast(LanePayload(self._lane, payload))
+
+    def send_to(self, member_id: str, payload) -> None:
+        self._inner.send_to(member_id, LanePayload(self._lane, payload))
+
+    def set_members(self, member_ids) -> None:
+        # membership is the PRIMARY coalescer's concern (dynamic
+        # membership is unsupported at lanes > 1 anyway)
+        pass
+
+
 class HoneyBadger:
     """One validator node (reference honeybadger.go:18-34 + the absent
     epoch driver).  Implements transport.base.Handler, plus the
@@ -491,8 +526,33 @@ class HoneyBadger:
         authenticator=None,
         joining: bool = False,
         roster_version_base: int = 0,
+        lane: int = 0,
+        _primary=None,
     ) -> None:
         self.config = config
+        # -- horizontal shard-out (Config.lanes, ISSUE 20) ---------------
+        # ``lane`` is this instance's shard index; ``_primary`` is the
+        # lane-0 instance when THIS instance is a sibling lane it
+        # constructed (internal — external construction sites always
+        # build lane 0, which builds its own siblings below).  The
+        # scope id qualifies every hub scope key with the lane so the
+        # S sibling lanes sharing one hub GC only their own epochs'
+        # clients; lane 0 keeps the bare node id, byte-identical to
+        # the single-lane build.
+        if not (0 <= lane < config.lanes):
+            raise ValueError(f"lane={lane} out of range for lanes={config.lanes}")
+        if (_primary is None) != (lane == 0):
+            raise ValueError("sibling lanes are built by their lane-0 primary")
+        self.lane = lane
+        self._primary = _primary
+        self._scope_id = node_id if lane == 0 else (node_id, lane)
+        # trace-event lane tag: empty at lanes=1 so the historical
+        # event shapes (and goldens) stay byte-identical
+        self._lane_kw = {"lane": lane} if config.lanes > 1 else {}
+        # populated at the END of __init__ (lane-0 primary only):
+        # sibling lane instances + the cross-lane merge cursor
+        self.lanes: List["HoneyBadger"] = [self]
+        self._merge = None
         # cluster simulations pass one shared make_tx_parse_memo()
         # across all nodes; real deployments leave it None
         self._tx_parse_memo = tx_parse_memo
@@ -529,7 +589,8 @@ class HoneyBadger:
         # node's epoch GC never drops a peer's clients.  Real
         # deployments (one validator per host) keep per-node hubs.
         self.hub = CryptoHub(self.crypto) if hub is None else hub
-        self.hub.register((node_id, "hb"), self)  # permanent: dec-share pools
+        # permanent: dec-share pools (lane-qualified under shard-out)
+        self.hub.register((self._scope_id, "hb"), self)
 
         self.que = TxQueue()
         self._pending_coin_issues: List[tuple] = []
@@ -552,7 +613,13 @@ class HoneyBadger:
         # flight recorder (utils/trace.py): None when Config.trace is
         # off — every instrumentation site below guards on that, so
         # the disabled path is one attribute load + identity check
-        self.trace = maybe_recorder(config, node_id)
+        # sibling lanes share the primary's recorder: one node, one
+        # timeline — lane-scoped events carry the ``lane`` tag instead
+        self.trace = (
+            maybe_recorder(config, node_id)
+            if _primary is None
+            else _primary.trace
+        )
         if self.trace is not None:
             self.metrics.set_trace_stats(self.trace.stats)
             if hub is None:  # a private hub reports on our timeline
@@ -566,12 +633,20 @@ class HoneyBadger:
         # callback) buffers flush at the end of every entry point; a
         # transport that calls transport_manages_idle() moves flushing
         # to its quiescence point for whole-wave bundles.
-        self._coalesce = CoalescingBroadcaster(
-            out,
-            self.members,
-            trace=self.trace,
-            egress_columnar=config.egress_columnar,
-        )
+        if _primary is None:
+            self._coalesce = CoalescingBroadcaster(
+                out,
+                self.members,
+                trace=self.trace,
+                egress_columnar=config.egress_columnar,
+            )
+        else:
+            # ONE coalescer per node: sibling lanes tag their payloads
+            # (see _LaneTagger below) and ride the primary's
+            # per-receiver buffers, so a wave's flush ships ALL S
+            # lanes' traffic in the same bundles — S lanes must not
+            # multiply flushes or envelopes
+            self._coalesce = _primary._coalesce
         self._transport_managed = False
         # semantic-adversary seam (protocol.byzantine): when a behavior
         # is mounted, every outbound payload is offered to it once per
@@ -580,14 +655,18 @@ class HoneyBadger:
         # exactly like honest traffic.  None (the default) adds nothing
         # to the path.
         self.behavior = behavior
-        outward = self._coalesce
+        outward = (
+            self._coalesce
+            if _primary is None
+            else _LaneTagger(self._coalesce, lane)
+        )
         if behavior is not None:
             from cleisthenes_tpu.protocol.byzantine import (
                 BehaviorBroadcaster,
             )
 
             outward = BehaviorBroadcaster(
-                self._coalesce, self.members, behavior
+                outward, self.members, behavior
             )
             behavior.attach(self)
         self.out = _CountingBroadcaster(
@@ -645,7 +724,14 @@ class HoneyBadger:
         # seed-vs-SystemRandom fork lives in ONE audited helper
         # (utils.determinism.proposal_rng) — plane code never touches
         # the random module directly (staticcheck DET001).
-        self._rng = proposal_rng(config.seed, node_id)
+        # lane > 0 salts the stream with the lane id: sibling lanes
+        # are independent protocol instances and must not mirror lane
+        # 0's candidate sampling; lane 0 keeps the historical salt
+        # (byte-identical draws at lanes=1)
+        self._rng = proposal_rng(
+            config.seed,
+            node_id if lane == 0 else f"{node_id}#lane{lane}",
+        )
         # recently committed txs, for lazy dedup at candidate-poll time
         # (bounded: one entry per remembered epoch)
         self._committed_filter: Set[bytes] = set()
@@ -657,7 +743,13 @@ class HoneyBadger:
         # first into self.que.  mempool_capacity=0 keeps the exact
         # pre-ingress shape: add_transaction -> TxQueue directly.
         self.mempool = None
-        if config.mempool_capacity > 0:
+        if _primary is not None:
+            # ONE admission pool per node: admit() routes each tx to
+            # its hash-assigned lane's drain heap, and every lane
+            # drains only its own heap (_create_batch) — the per-lane
+            # ledgers stay disjoint by construction
+            self.mempool = _primary.mempool
+        elif config.mempool_capacity > 0:
             from cleisthenes_tpu.core.mempool import Mempool
 
             self.mempool = Mempool(
@@ -667,8 +759,10 @@ class HoneyBadger:
                 retry_after_ms=config.mempool_retry_after_ms,
                 seed=config.seed if config.seed is not None else 0,
                 on_evict=self._mempool_evicted,
+                lanes=config.lanes,
             )
         self.metrics.set_ingress(self._ingress_block)
+        self.metrics.set_lanes(self._lanes_block)
         # committed-batch fan-out beyond the single on_commit slot:
         # the ingress plane's subscription server registers here (one
         # listener per live subscriber feed), while on_commit stays
@@ -827,6 +921,48 @@ class HoneyBadger:
             self._reconfig.after_replay()
             self._maybe_activate_roster()
             self._maybe_teardown_retired()
+        # -- horizontal shard-out: sibling lanes + the merge ------------
+        # The lane-0 primary builds its S-1 sibling lane instances
+        # here, so every external construction site (hosts, clusters,
+        # harnesses) stays single-object: the primary IS the node.
+        # Siblings share the primary's hub, coalescer, mempool and
+        # trace recorder; each gets its own lane view of the WAL
+        # (lane-tagged record streams in the same file) and replays
+        # its own ordered-unsettled window independently.
+        if lane == 0 and config.lanes > 1:
+            from cleisthenes_tpu.core.merge import MergeCursor
+
+            for k in range(1, config.lanes):
+                self.lanes.append(
+                    HoneyBadger(
+                        config=config,
+                        node_id=node_id,
+                        member_ids=member_ids,
+                        keys=keys,
+                        out=out,
+                        auto_propose=auto_propose,
+                        batch_log=(
+                            None
+                            if batch_log is None
+                            else batch_log.lane_view(k)
+                        ),
+                        hub=self.hub,
+                        tx_parse_memo=tx_parse_memo,
+                        joining=joining,
+                        roster_version_base=roster_version_base,
+                        lane=k,
+                        _primary=self,
+                    )
+                )
+            # the deterministic total-order merge over the S settled
+            # lane streams; restart replay re-seeds the emitted prefix
+            # WITHOUT firing commit listeners (matching single-lane
+            # replay, which never re-fires on_commit)
+            self._merge = MergeCursor(config.lanes)
+            for k, hb in enumerate(self.lanes):
+                for e, b in enumerate(hb.committed_batches):
+                    self._merge.push(k, e, b)
+            self._merge.drain()
 
     def _remember_committed(self, seen: Set[bytes]) -> None:
         """Fold one epoch's committed txs into the bounded duplicate
@@ -839,10 +975,21 @@ class HoneyBadger:
     # -- public API (reference honeybadger.go:36-59) -----------------------
 
     def add_transaction(self, tx: bytes) -> None:
-        """Reference honeybadger.go:52-54."""
+        """Reference honeybadger.go:52-54.  Under lane shard-out the
+        primary routes each tx to its hash-assigned lane's queue (the
+        same ``lane_of`` partition admission uses), so direct pushes
+        and mempool-admitted txs land in the same lane."""
         if not isinstance(tx, (bytes, bytearray)):
             raise TypeError("transactions are opaque bytes")
-        self.que.push(bytes(tx))
+        tx = bytes(tx)
+        if self._merge is not None:
+            from cleisthenes_tpu.core.merge import lane_of
+            from cleisthenes_tpu.core.mempool import tx_digest
+
+            seed = self.config.seed if self.config.seed is not None else 0
+            self.lanes[lane_of(seed, tx_digest(tx), self.config.lanes)].que.push(tx)
+            return
+        self.que.push(tx)
 
     # -- ingress plane (core.mempool + transport.ingress) ------------------
 
@@ -893,6 +1040,29 @@ class HoneyBadger:
             out["subscribers"] = self._subscriber_count()
         return out
 
+    def _lanes_block(self) -> Dict[str, object]:
+        """snapshot()["lanes"] provider: per-lane frontier gauges,
+        the merged settled frontier, and the admission partition's
+        skew witness.  On the lane-0 primary the lists span all S
+        lanes; at lanes=1 they are one-element (the schema-stable
+        single-lane shape)."""
+        lanes = self.lanes
+        fill = (
+            list(self.mempool.lane_fill())
+            if self.mempool is not None
+            else [0] * len(lanes)
+        )
+        return {
+            "lanes": len(lanes),
+            "merge_frontier": self.merged_settled_frontier,
+            "ordered_epochs": [hb.epoch for hb in lanes],
+            "settled_epochs": [
+                len(hb.committed_batches) for hb in lanes
+            ],
+            "lane_fill": fill,
+            "partition_skew": (max(fill) - min(fill)) if fill else 0,
+        }
+
     def set_subscriber_provider(
         self, provider: Optional[Callable[[], int]]
     ) -> None:
@@ -911,13 +1081,70 @@ class HoneyBadger:
     def _notify_commit(self, epoch: int, batch: Batch) -> None:
         """The single settlement fan-out point: retire the batch's txs
         from the mempool's in-flight accounting, then fire on_commit
-        and every registered listener."""
+        and every registered listener.  Under lane shard-out the
+        settlement instead feeds the primary's merge cursor; listeners
+        fire from the MERGED total order (with merged sequence
+        numbers), never per lane."""
         if self.mempool is not None:
             self.mempool.mark_settled(batch.tx_list())
+        if self._primary is not None:
+            self._primary._on_lane_settled(self.lane, epoch, batch)
+            return
+        if self._merge is not None:
+            self._on_lane_settled(0, epoch, batch)
+            return
         if self.on_commit is not None:
             self.on_commit(epoch, batch)
         for fn in self._commit_listeners:
             fn(epoch, batch)
+
+    def _on_lane_settled(self, lane: int, epoch: int, batch: Batch) -> None:
+        """Primary-side merge feed: one lane settled one epoch.  Push
+        the slot, then emit every newly contiguous merged slot (a
+        pure function of the committed bytes — identical on every
+        honest node however the lanes' settlements interleave)."""
+        self._merge.push(lane, epoch, batch)
+        for seq, mlane, mepoch, mbatch in self._merge.drain():
+            if self.trace is not None:
+                self.trace.instant(
+                    "merge", "emit", seq=seq, lane=mlane, epoch=mepoch,
+                    txs=len(mbatch),
+                )
+            if self.on_commit is not None:
+                self.on_commit(seq, mbatch)
+            for fn in self._commit_listeners:
+                fn(seq, mbatch)
+
+    # -- merged total-order accessors (lane shard-out) ---------------------
+
+    @property
+    def merged_batches(self) -> List[Batch]:
+        """The settled batches in MERGED total order (== the per-lane
+        committed list at lanes=1): the ledger every cross-node
+        byte-identity comparison and subscription replay reads."""
+        return (
+            self.committed_batches
+            if self._merge is None
+            else self._merge.merged
+        )
+
+    @property
+    def merged_settled_frontier(self) -> int:
+        """Number of merge-emitted slots (== the settled epoch count
+        at lanes=1)."""
+        return (
+            len(self.committed_batches)
+            if self._merge is None
+            else self._merge.frontier
+        )
+
+    @property
+    def merged_ordered_frontier(self) -> int:
+        """Sum of the lanes' ordered frontiers (== ``self.epoch`` at
+        lanes=1): the ingress plane's ordered-work gauge."""
+        if self._merge is None:
+            return self.epoch
+        return sum(hb.epoch for hb in self.lanes)
 
     def start_epoch(self, epoch: Optional[int] = None) -> None:
         """Select a batch, encrypt it, and input it to this epoch's ACS
@@ -933,6 +1160,13 @@ class HoneyBadger:
             if epoch is None:
                 self._propose_into(self.epoch)
                 self._drive_pipeline()
+                for hb in self.lanes[1:]:
+                    # the external kick reaches every lane: siblings
+                    # propose into their own frontiers (empty batches
+                    # are fine — lanes run independent HBBFT streams)
+                    if not hb._retired_self:
+                        hb._propose_into(hb.epoch)
+                        hb._drive_pipeline()
             else:
                 self._propose_into(epoch)
         finally:
@@ -953,9 +1187,12 @@ class HoneyBadger:
         if tr is not None:
             ahead = target - self.epoch
             if ahead > 0:  # K-deep window position; frontier opens
-                tr.instant("epoch", "open", epoch=target, ahead=ahead)
+                tr.instant(
+                    "epoch", "open", epoch=target, ahead=ahead,
+                    **self._lane_kw,
+                )
             else:  # keep the depth-1 event byte-stable
-                tr.instant("epoch", "open", epoch=target)
+                tr.instant("epoch", "open", epoch=target, **self._lane_kw)
         t0 = 0.0 if tr is None else tr.now()
         es.my_txs = self._create_batch()
         # the EPOCH's key set (an epoch past an activation
@@ -1042,14 +1279,22 @@ class HoneyBadger:
         propose-gating twin of pending_tx_count."""
         if len(self.que) > 0:
             return True
-        return (
-            self.mempool is not None and self.mempool.pending_count() > 0
-        )
+        return self._staged_count() > 0
+
+    def _staged_count(self) -> int:
+        """Mempool entries awaiting THIS lane's drain (the whole pool
+        at lanes=1 — the historical single-heap read)."""
+        if self.mempool is None:
+            return 0
+        if self.config.lanes > 1:
+            return self.mempool.pending_count(self.lane)
+        return self.mempool.pending_count()
 
     def pending_tx_count(self) -> int:
-        if self.mempool is None:
-            return len(self.que)
-        return len(self.que) + self.mempool.pending_count()
+        own = len(self.que) + self._staged_count()
+        for hb in self.lanes[1:]:  # primary fans in; empty otherwise
+            own += hb.pending_tx_count()
+        return own
 
     def outstanding_tx_count(self) -> int:
         """Queue depth PLUS transactions absorbed into in-flight
@@ -1064,14 +1309,14 @@ class HoneyBadger:
         drain count too — client-acked work invisible to the queue
         and to every epoch's my_txs must still trip the
         queue-backpressure detector."""
-        staged = (
-            0 if self.mempool is None else self.mempool.pending_count()
-        )
-        return staged + len(self.que) + sum(
+        total = self._staged_count() + len(self.que) + sum(
             len(es.my_txs)
             for es in list(self._epochs.values())
             if es.proposed and not es.committed
         )
+        for hb in self.lanes[1:]:  # primary fans in; empty otherwise
+            total += hb.outstanding_tx_count()
+        return total
 
     @property
     def _two_frontier(self) -> bool:
@@ -1379,7 +1624,9 @@ class HoneyBadger:
         # is unchanged whether a tx arrived via add_transaction or
         # through the ingress admission pipeline
         if self.mempool is not None:
-            self.mempool.drain_into(self.que, self.b)
+            # each lane drains ONLY its own heap (lane 0 == the only
+            # heap at lanes=1): the partition is admission-time
+            self.mempool.drain_into(self.que, self.b, lane=self.lane)
         candidates = self._load_candidate_txs(min(self.b, len(self.que)))
         # the ACTIVE roster's width (b/n sampling follows the live n)
         n = self.active_view.config.n
@@ -1425,6 +1672,8 @@ class HoneyBadger:
         equivalence-test comparison arm; outbound coalescing still
         moves to the idle callback either way."""
         self._transport_managed = True
+        for hb in self.lanes[1:]:  # siblings drain at OUR idle points
+            hb._transport_managed = True
         if self.config.hub_wave_flush:
             self.hub.defer = True
 
@@ -1441,37 +1690,72 @@ class HoneyBadger:
             # point absorbed (the dispatch-amortization denominator)
             tr.instant("transport", "wave", msgs=self._trace_wave_msgs)
             self._trace_wave_msgs = 0
-        self._drain_coin_issues()
-        # the trailing settler (two-frontier mode) runs HERE, off the
-        # ordered critical path: issue pending dec shares, probe
-        # combines, settle ready epochs in order.  It runs before the
-        # hub flush so any CP-verification work it requests rides this
-        # wave's batched dispatch, not the next one's.
-        self._drive_settler()
-        # top up the K-deep in-flight window before the hub flush:
-        # fresh proposals' RBC traffic joins this turn's bundle
-        self._drive_pipeline()
+        # lane fan-out: ``self.lanes`` is [self] at lanes=1, so the
+        # single-lane call order below is byte-identical to the
+        # historical body.  All S lanes' drains run around ONE hub
+        # flush and ONE coalescer flush — the dispatch-flatness
+        # requirement (S lanes share the wave's dispatches instead of
+        # multiplying them).
+        lanes = self.lanes
+        self._drive_lane_lockstep()
+        for hb in lanes:
+            hb._drain_coin_issues()
+            # the trailing settler (two-frontier mode) runs HERE, off
+            # the ordered critical path: issue pending dec shares,
+            # probe combines, settle ready epochs in order.  It runs
+            # before the hub flush so any CP-verification work it
+            # requests rides this wave's batched dispatch, not the
+            # next one's.
+            hb._drive_settler()
+            # top up the K-deep in-flight window before the hub flush:
+            # fresh proposals' RBC traffic joins this turn's bundle
+            hb._drive_pipeline()
         self.hub.run_deferred()
-        # the flush itself can advance rounds and queue NEW coin
-        # issues (coin reveal -> advance -> next round's aux quorum);
-        # drain again so they ride this turn's bundle, not the next
-        # inbound message's
-        self._drain_coin_issues()
-        # eagerly staged dec shares (epochs ordered during this wave,
-        # including inside run_deferred) piggyback on this flush
-        self._drain_dec_issues()
-        self._maybe_chase_stall()
+        for hb in lanes:
+            # the flush itself can advance rounds and queue NEW coin
+            # issues (coin reveal -> advance -> next round's aux
+            # quorum); drain again so they ride this turn's bundle,
+            # not the next inbound message's
+            hb._drain_coin_issues()
+            # eagerly staged dec shares (epochs ordered during this
+            # wave, including inside run_deferred) piggyback on this
+            # flush
+            hb._drain_dec_issues()
+            hb._maybe_chase_stall()
         self._coalesce.flush()
+
+    def _drive_lane_lockstep(self) -> None:
+        """Drag lagging lanes toward the fastest lane's ordered
+        frontier (primary only, lanes > 1).  The merged total order
+        enumerates slots epoch-major, so a lane that quiesces epochs
+        behind its siblings parks the merge; proposing (possibly
+        empty) epochs into the gap fills the slots.  Every honest
+        node runs the same rule, so the catch-up epochs reach their
+        n-f proposal quorums.  Terminates: lanes at the max frontier
+        are never kicked."""
+        if self._merge is None or not self.auto_propose:
+            return
+        lanes = self.lanes
+        target = max(hb.epoch for hb in lanes)
+        for hb in lanes:
+            if hb.epoch >= target or hb._retired_self:
+                continue
+            es = hb._epochs.get(hb.epoch)
+            if es is None or not es.proposed:
+                hb._propose_into(hb.epoch)
 
     def _exit_turn(self) -> None:
         """Self-draining mode: every public entry point leaves no
         buffered outbound behind (transports without idle callbacks
-        would otherwise strand the turn's messages)."""
+        would otherwise strand the turn's messages).  ``self.lanes``
+        is [self] at lanes=1 — the historical body, byte-identical."""
         if not self._transport_managed:
-            self._drain_coin_issues()
-            self._drive_settler()
-            self._drive_pipeline()
-            self._drain_dec_issues()
+            self._drive_lane_lockstep()
+            for hb in self.lanes:
+                hb._drain_coin_issues()
+                hb._drive_settler()
+                hb._drive_pipeline()
+                hb._drain_dec_issues()
             self._coalesce.flush()
 
     def _queue_coin_issue(self, bba, rnd: int) -> None:
@@ -1597,6 +1881,18 @@ class HoneyBadger:
         # exists exactly for nodes outside the window (CatchupReq has
         # no ``epoch`` field at all — it carries a range start)
         pcls = payload.__class__
+        if pcls is LanePayload:
+            # lane shard-out demux: lane-k frames route into the
+            # lane-k sibling instance (only the lane-0 primary ever
+            # receives these — lane 0 traffic is never wrapped, so
+            # the single-lane build never reaches this branch)
+            lanes = self.lanes
+            l = payload.lane
+            if self.lane == 0 and 0 < l < len(lanes):
+                sib = lanes[l]
+                sib._idle_rx += 1  # the sibling's stall-watchdog clock
+                sib._serve_payload(sender_id, payload.inner)
+            return
         if pcls is CatchupReqPayload:
             self._handle_catchup_req(sender_id, payload)
             return
@@ -1714,6 +2010,7 @@ class HoneyBadger:
                 coin_issue_sink=self._queue_coin_issue,
                 trace=self.trace,
                 metrics=self.metrics,
+                scope=self._scope_id,
             )
             acs.on_output = self._on_acs_output
             es = _EpochState(acs, view)
@@ -1731,7 +2028,8 @@ class HoneyBadger:
         tr = self.trace
         if tr is not None:
             tr.instant(
-                "epoch", "acs_output", epoch=epoch, proposers=len(output)
+                "epoch", "acs_output", epoch=epoch, proposers=len(output),
+                **self._lane_kw,
             )
         if self._two_frontier:
             # Two-frontier split: commit the CIPHERTEXT ordering now
@@ -1937,7 +2235,8 @@ class HoneyBadger:
                 ):
                     self._park_traced = epoch
                     self.trace.instant(
-                        "epoch", "order_parked", epoch=epoch, lag=lag
+                        "epoch", "order_parked", epoch=epoch, lag=lag,
+                        **self._lane_kw,
                     )
                 return
             self._record_ordered(epoch, es)
@@ -1954,6 +2253,7 @@ class HoneyBadger:
                     "ordered",
                     epoch=epoch,
                     proposers=len(es.output),
+                    **self._lane_kw,
                 )
             self.log.debug("ordered", epoch=epoch)
             self._advance_epoch()
@@ -2737,14 +3037,16 @@ class HoneyBadger:
         self.metrics.epoch_committed(epoch, len(batch))
         if self.trace is not None:
             self.trace.instant(
-                "epoch", "commit", epoch=epoch, txs=len(batch)
+                "epoch", "commit", epoch=epoch, txs=len(batch),
+                **self._lane_kw,
             )
             if es.t_ordered:
                 # the settle track made visible: one span from the
                 # ciphertext-ordered commit to plaintext settlement —
                 # the tpke mass that LEFT the open->ordered window
                 self.trace.complete(
-                    "settle", "decrypt_lag", es.t_ordered, epoch=epoch
+                    "settle", "decrypt_lag", es.t_ordered, epoch=epoch,
+                    **self._lane_kw,
                 )
         if self.batch_log is not None:
             self.batch_log.append(epoch, batch)
@@ -2786,7 +3088,7 @@ class HoneyBadger:
             and (not self._two_frontier or e < settled)
         ]:
             del self._epochs[stale]
-            self.hub.drop_scope((self.node_id, stale))
+            self.hub.drop_scope((self._scope_id, stale))
 
     def _advance_epoch(self) -> None:
         """Advance the live-protocol frontier ``self.epoch``: at every

@@ -77,6 +77,7 @@ class RBC:
         index=None,
         trace=None,
         metrics=None,
+        scope=None,
     ) -> None:
         self.n = config.n
         self.f = config.f
@@ -117,8 +118,12 @@ class RBC:
         bank.attach(index, self)
         # scope is (owner, epoch): a hub may be SHARED by many
         # in-proc validators (cluster-batched dispatches), and one
-        # node advancing epochs must only drop ITS clients
-        self.hub.register((owner, epoch), self)
+        # node advancing epochs must only drop ITS clients.  Lane
+        # shard-out (Config.lanes) further qualifies ``scope`` with
+        # the lane id — sibling lanes of one node share the hub and
+        # run the same epoch numbers concurrently, so epoch GC must
+        # be lane-scoped too; at lanes=1, scope == owner.
+        self.hub.register((owner if scope is None else scope, epoch), self)
         # flight recorder (None = tracing off; utils/trace.py)
         self.trace = trace
         # owner-node metrics (None in standalone unit tests): only the

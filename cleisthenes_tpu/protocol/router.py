@@ -53,6 +53,7 @@ from cleisthenes_tpu.transport.message import (
     DecShareBatchPayload,
     DecSharePayload,
     EchoBatchPayload,
+    LanePayload,
     RbcPayload,
     RbcType,
     ReadyBatchPayload,
@@ -147,9 +148,23 @@ class WaveRouter:
     # -- demux -------------------------------------------------------------
 
     def _demux(self, cols, sender: str, p) -> bool:
-        """Append one payload to its (kind, epoch) column; False when
-        the payload is an ordering barrier the caller must flush for."""
+        """Append one payload to its (kind, epoch) column — or, for a
+        lane-wrapped payload (Config.lanes > 1), to its
+        (kind, epoch, lane) column; False when the payload is an
+        ordering barrier the caller must flush for."""
         cls = p.__class__
+        lane = 0
+        if cls is LanePayload:
+            lane = p.lane
+            if not (0 < lane < len(self._hb.lanes)):
+                return True  # unknown lane: drop, like the scalar arm
+            p = p.inner
+            cls = p.__class__
+            if cls in _CATCHUP_PAYLOADS:
+                # barrier: the scalar chain demuxes the WRAPPED
+                # payload into the sibling (route() passes the
+                # original payload object)
+                return False
         if cls is BbaBatchPayload:
             item = (sender, p.type, p.round, p.value, p.proposers)
             key = (_K_VOTE, p.epoch)
@@ -195,6 +210,11 @@ class WaveRouter:
             return False
         else:  # unknown/epochless payloads drop, like the scalar arm
             return True
+        if lane:
+            # lane columns stay distinct but ride the SAME wave: one
+            # route() pass, one _dispatch_all — S lanes' traffic per
+            # wave without S× routing passes
+            key = key + (lane,)
         col = cols.get(key)
         if col is None:
             cols[key] = [item]
@@ -206,7 +226,12 @@ class WaveRouter:
 
     def _dispatch_all(self, cols) -> None:
         for key, items in cols.items():
-            self._dispatch(key[0], key[1], items)
+            if len(key) == 3:  # (kind, epoch, lane): a sibling's column
+                sib = self._hb.lanes[key[2]]
+                sib._idle_rx += len(items)  # its stall-watchdog clock
+                sib._router._dispatch(key[0], key[1], items)
+            else:
+                self._dispatch(key[0], key[1], items)
 
     def _dispatch(self, kind: str, epoch: int, items) -> None:
         """One column = one handler invocation (the counter perfgate

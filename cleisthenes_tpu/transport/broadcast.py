@@ -34,6 +34,7 @@ from cleisthenes_tpu.transport.message import (
     DecShareBatchPayload,
     DecSharePayload,
     EchoBatchPayload,
+    LanePayload,
     Message,
     Payload,
     RbcPayload,
@@ -113,20 +114,32 @@ def _columnarize(buf: List[Payload]) -> List[Payload]:
     order: List[tuple] = []
     for p in buf:
         cls = p.__class__
+        # lane shard-out (ISSUE 20): a lane's runs merge under a
+        # lane-prefixed key — the merged column re-wraps below, so S
+        # lanes' traffic columnarizes exactly as lane 0's does and
+        # still shares the one bundle per (receiver, wave)
+        lane = 0
+        q = p
+        if cls is LanePayload:
+            lane = p.lane
+            q = p.inner
+            cls = q.__class__
         if cls is BbaPayload:
-            key = ("b", p.type, p.epoch, p.round, p.value)
+            key = ("b", q.type, q.epoch, q.round, q.value)
         elif cls is CoinPayload:
-            key = ("c", p.epoch, p.round, p.index)
+            key = ("c", q.epoch, q.round, q.index)
         elif cls is DecSharePayload:
-            key = ("d", p.epoch, p.index)
-        elif cls is RbcPayload and p.type is RbcType.READY:
-            key = ("r", p.epoch)
-        elif cls is RbcPayload and p.type is RbcType.ECHO:
+            key = ("d", q.epoch, q.index)
+        elif cls is RbcPayload and q.type is RbcType.READY:
+            key = ("r", q.epoch)
+        elif cls is RbcPayload and q.type is RbcType.ECHO:
             # one turn's ECHO fan-out shares the sender's shard slot
             # (it echoes the VALs it received, all at its own index)
-            key = ("e", p.epoch, p.shard_index)
+            key = ("e", q.epoch, q.shard_index)
         else:
             key = ("solo", len(order))  # preserves position, no merge
+        if lane and key[0] != "solo":
+            key = ("L", lane) + key
         if key in groups:
             groups[key].append(p)
         else:
@@ -138,58 +151,54 @@ def _columnarize(buf: List[Payload]) -> List[Payload]:
         if len(run) == 1:
             out.append(run[0])
             continue
+        lane = 0
+        if key[0] == "L":
+            lane = key[1]
+            key = key[2:]
+            run = [p.inner for p in run]
         tag = key[0]
         if tag == "b":
             p0 = run[0]
-            out.append(
-                BbaBatchPayload(
-                    p0.type, p0.epoch, p0.round, p0.value,
-                    tuple(p.proposer for p in run),
-                )
+            col = BbaBatchPayload(
+                p0.type, p0.epoch, p0.round, p0.value,
+                tuple(p.proposer for p in run),
             )
         elif tag == "c":
             p0 = run[0]
-            out.append(
-                CoinBatchPayload(
-                    p0.epoch, p0.round, p0.index,
-                    tuple(p.proposer for p in run),
-                    tuple(p.d for p in run),
-                    tuple(p.e for p in run),
-                    tuple(p.z for p in run),
-                )
+            col = CoinBatchPayload(
+                p0.epoch, p0.round, p0.index,
+                tuple(p.proposer for p in run),
+                tuple(p.d for p in run),
+                tuple(p.e for p in run),
+                tuple(p.z for p in run),
             )
         elif tag == "d":
             p0 = run[0]
-            out.append(
-                DecShareBatchPayload(
-                    p0.epoch, p0.index,
-                    tuple(p.proposer for p in run),
-                    tuple(p.d for p in run),
-                    tuple(p.e for p in run),
-                    tuple(p.z for p in run),
-                )
+            col = DecShareBatchPayload(
+                p0.epoch, p0.index,
+                tuple(p.proposer for p in run),
+                tuple(p.d for p in run),
+                tuple(p.e for p in run),
+                tuple(p.z for p in run),
             )
         elif tag == "r":
             p0 = run[0]
-            out.append(
-                ReadyBatchPayload(
-                    p0.epoch,
-                    tuple(p.proposer for p in run),
-                    tuple(p.root_hash for p in run),
-                )
+            col = ReadyBatchPayload(
+                p0.epoch,
+                tuple(p.proposer for p in run),
+                tuple(p.root_hash for p in run),
             )
         else:  # "e"
             p0 = run[0]
-            out.append(
-                EchoBatchPayload(
-                    p0.epoch,
-                    p0.shard_index,
-                    tuple(p.proposer for p in run),
-                    tuple(p.root_hash for p in run),
-                    tuple(p.branch for p in run),
-                    tuple(p.shard for p in run),
-                )
+            col = EchoBatchPayload(
+                p0.epoch,
+                p0.shard_index,
+                tuple(p.proposer for p in run),
+                tuple(p.root_hash for p in run),
+                tuple(p.branch for p in run),
+                tuple(p.shard for p in run),
             )
+        out.append(LanePayload(lane, col) if lane else col)
     return out
 
 

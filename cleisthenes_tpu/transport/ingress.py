@@ -165,12 +165,17 @@ class IngressPlane:
             tr.complete("ingress", "submit", t0, status=verdict.status)
         if status == IngressStatus.OK and self._on_admitted is not None:
             self._on_admitted()
+        # frontiers in the ack are MERGED total-order frontiers: at
+        # lanes=1 they equal (epoch, settled_epoch) byte-for-byte; at
+        # lanes>1 they span every lane, so a client's exactly-once
+        # audit window is one number regardless of which lane its tx
+        # hashed into
         ack = IngressAckPayload(
             client_id=payload.client_id,
             nonce=payload.nonce,
             status=int(status),
-            ordered_epoch=self._node.epoch,
-            settled_epoch=self._node.settled_epoch,
+            ordered_epoch=self._node.merged_ordered_frontier,
+            settled_epoch=self._node.merged_settled_frontier,
             retry_after_ms=verdict.retry_after_ms,
         )
         return encode_client_frame(ack)
@@ -187,7 +192,10 @@ class IngressPlane:
         replay/live seam."""
         feed = SubscriptionFeed()
         with self._lock:
-            batches = self._node.committed_batches
+            # merged total order (== committed_batches at lanes=1):
+            # subscribers see ONE slot sequence across all lanes, the
+            # same stream the live fan-out (add_commit_listener) emits
+            batches = self._node.merged_batches
             for epoch in range(max(0, from_epoch), len(batches)):
                 feed._push(
                     encode_client_frame(

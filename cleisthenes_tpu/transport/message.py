@@ -271,6 +271,24 @@ class BundlePayload(NamedTuple):
     items: Tuple["Payload", ...]
 
 
+class LanePayload(NamedTuple):
+    """One protocol payload addressed to a consensus lane (ISSUE 20).
+
+    Horizontal shard-out runs S independent HBBFT lane instances over
+    one roster; lanes > 0 wrap every outbound payload in this frame so
+    lane traffic rides the SAME coalesced bundles, delivery waves and
+    MAC passes as lane 0 — the receiver demuxes by ``lane`` before the
+    epoch demux.  Lane 0 never wraps (S=1 wire streams stay
+    byte-identical to the pre-lane build).  A LanePayload may appear
+    inside a bundle; a bundle or another LanePayload may NOT appear
+    inside a LanePayload (the lane axis is outermost-but-one, framing
+    stays non-recursive).
+    """
+
+    lane: int
+    inner: "Payload"
+
+
 # -- columnar wave payloads -------------------------------------------------
 #
 # Within one wave a node emits the SAME logical vote across many
@@ -364,6 +382,7 @@ Payload = Union[
     IngressAckPayload,
     IngressSubscribePayload,
     IngressBatchPayload,
+    LanePayload,
 ]
 
 # oneof discriminants (reference message.proto:18-22 has rbc=3, bba=4;
@@ -397,6 +416,7 @@ _KIND_INGRESS_SUBMIT = 17
 _KIND_INGRESS_ACK = 18
 _KIND_INGRESS_SUB = 19
 _KIND_INGRESS_BATCH = 20
+_KIND_LANE = 21  # staticcheck: allow[WIRE001] native-only lane shard-out framing (no pb slot)
 
 # DoS bound on per-instance columns (a roster is <= 256 under the
 # GF(2^8) shard cap; 4096 leaves margin for multi-round merges)
@@ -585,6 +605,17 @@ def _encode_payload(p: Payload) -> Tuple[int, bytes]:
         out.append(struct.pack(">Q", p.epoch))
         _pack_bytes(out, p.body)
         return _KIND_INGRESS_BATCH, b"".join(out)
+    if isinstance(p, LanePayload):
+        if not (0 <= p.lane <= 255):
+            raise ValueError(f"lane {p.lane} out of wire range")
+        kind, body = _encode_payload(p.inner)
+        if kind in (_KIND_BUNDLE, _KIND_LANE):
+            raise ValueError(
+                "bundle/lane payloads are not allowed inside a lane frame"
+            )
+        out.append(struct.pack(">IB", p.lane, kind))
+        _pack_bytes(out, body)
+        return _KIND_LANE, b"".join(out)
     if isinstance(p, BundlePayload):
         if len(p.items) > MAX_BUNDLE_ITEMS:
             raise ValueError(f"bundle of {len(p.items)} items exceeds cap")
@@ -935,6 +966,29 @@ def _parse_payload(d: bytes, o: int, end: int, kind: int):
         (epoch,) = _U64.unpack_from(d, o)
         body, o = _field(d, o + 8, end)
         return IngressBatchPayload(epoch, body), o
+    if kind == _KIND_LANE:
+        if o + 9 > end:
+            raise ValueError("truncated frame")
+        (lane,) = _U32.unpack_from(d, o)
+        if lane > 255:
+            raise ValueError(f"lane {lane} out of wire range")
+        k = d[o + 4]
+        if k in (_KIND_BUNDLE, _KIND_LANE):
+            raise ValueError(
+                "bundle/lane payloads are not allowed inside a lane frame"
+            )
+        (ln,) = _U32.unpack_from(d, o + 5)
+        if ln > MAX_FIELD_BYTES:
+            raise ValueError(f"field length {ln} exceeds cap")
+        o += 9
+        item_end = o + ln
+        if item_end > end:
+            raise ValueError("truncated frame")
+        inner, consumed = _parse_payload(d, o, item_end, k)
+        if consumed != item_end:
+            # canonical-or-reject: the MAC covers these bytes
+            raise ValueError("trailing bytes in payload body")
+        return LanePayload(lane, inner), item_end
     if kind == _KIND_BUNDLE:
         if o + 4 > end:
             raise ValueError("truncated frame")
@@ -1360,6 +1414,7 @@ __all__ = [
     "IngressAckPayload",
     "IngressSubscribePayload",
     "IngressBatchPayload",
+    "LanePayload",
     "IngressStatus",
     "RbcType",
     "BbaType",

@@ -486,6 +486,64 @@ def render_prometheus(targets: Sequence[ObsTarget]) -> str:
             labels,
             int(ingress["mempool_depth"]),
         )
+        # lane shard-out families (always present — the lanes block
+        # is in every snapshot, collapsed to one lane at Config.lanes=1)
+        lanes_blk = snap["lanes"]
+        exp.add(
+            exp.family(
+                "lane_count", "gauge",
+                "configured consensus lanes (Config.lanes; 1 = the "
+                "single-lane build)",
+            ),
+            labels,
+            int(lanes_blk["lanes"]),
+        )
+        exp.add(
+            exp.family(
+                "lane_merge_frontier", "gauge",
+                "merge-emitted total-order slots (== the settled "
+                "epoch count at one lane)",
+            ),
+            labels,
+            int(lanes_blk["merge_frontier"]),
+        )
+        exp.add(
+            exp.family(
+                "lane_partition_skew", "gauge",
+                "max-min lifetime admissions across lanes (the "
+                "tx-hash partitioner's balance witness)",
+            ),
+            labels,
+            int(lanes_blk["partition_skew"]),
+        )
+        for k, v in enumerate(lanes_blk["ordered_epochs"]):
+            exp.add(
+                exp.family(
+                    "lane_ordered_epochs", "gauge",
+                    "per-lane ordered frontier (labeled by lane)",
+                ),
+                {**labels, "lane": k},
+                int(v),
+            )
+        for k, v in enumerate(lanes_blk["settled_epochs"]):
+            exp.add(
+                exp.family(
+                    "lane_settled_epochs", "gauge",
+                    "per-lane settled frontier (labeled by lane)",
+                ),
+                {**labels, "lane": k},
+                int(v),
+            )
+        for k, v in enumerate(lanes_blk["lane_fill"]):
+            exp.add(
+                exp.family(
+                    "lane_fill_total", "counter",
+                    "lifetime mempool admissions per lane (labeled "
+                    "by lane)",
+                ),
+                {**labels, "lane": k},
+                int(v),
+            )
         for peer, ph in snap.get("transport_health", {}).items():
             plabels = {**labels, "peer": peer}
             exp.add(

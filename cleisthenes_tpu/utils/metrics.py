@@ -295,6 +295,10 @@ class Metrics:
         # mempool admission tallies + subscriber gauge) — folds into
         # the ALWAYS-present zeroed snapshot()["ingress"] block
         self._ingress: Optional[Callable[[], Dict]] = None
+        # lane shard-out provider (set by the owning lane-0 primary:
+        # per-lane frontier gauges, merge frontier, partition skew) —
+        # folds into the ALWAYS-present snapshot()["lanes"] block
+        self._lanes: Optional[Callable[[], Dict]] = None
 
     def set_transport_health(
         self, provider: Optional[Callable[[], Dict]]
@@ -341,6 +345,11 @@ class Metrics:
     def set_ingress(self, provider: Optional[Callable[[], Dict]]) -> None:
         """Ingress-plane provider (mempool tallies + subscribers)."""
         self._ingress = provider
+
+    def set_lanes(self, provider: Optional[Callable[[], Dict]]) -> None:
+        """Lane shard-out provider (Config.lanes: per-lane frontiers,
+        merge frontier, partition skew)."""
+        self._lanes = provider
 
     def decrypt_lag_epochs(self) -> int:
         """Ordered frontier - settled frontier (0 when no provider is
@@ -552,6 +561,21 @@ class Metrics:
         if self._ingress is not None:
             ingress.update(self._ingress())
         out["ingress"] = ingress
+        # lane shard-out block: ALWAYS present with every key (the
+        # PR-9 schema-stability rule) — a single-lane node reports
+        # lanes=1 with one-element gauge lists, so scrapers see the
+        # same shape at every S
+        lanes: Dict[str, object] = {
+            "lanes": 1,
+            "merge_frontier": 0,
+            "ordered_epochs": [0],
+            "settled_epochs": [0],
+            "lane_fill": [0],
+            "partition_skew": 0,
+        }
+        if self._lanes is not None:
+            lanes.update(self._lanes())
+        out["lanes"] = lanes
         if self._transport_health is not None:
             out["transport_health"] = self._transport_health()
         if self._trace_stats is not None:

@@ -1,0 +1,21 @@
+"""DET005 fixture (lane shard-out): lane-scoped code reading the
+bare primary-lane frontier instead of resolving through the
+lane-indexed accessor."""
+
+
+class Node:
+    def __init__(self, config, lanes):
+        self.config = config
+        self.lanes = lanes
+        self.epoch = 0
+        self.settled_epoch = 0
+        self.committed_batches = []
+
+    def lane_frontier(self, lane):
+        return self.epoch  # BAD:DET005
+
+    def settle_column(self, lane, items):
+        depth = len(self.committed_batches)  # BAD:DET005
+        if self.settled_epoch > 0:  # BAD:DET005
+            return items[:depth]
+        return items

@@ -1,0 +1,24 @@
+"""DET005 fixture (lane shard-out, clean): lane-scoped code resolving
+frontiers through the lane-indexed accessor, and bare primary-lane
+frontier reads confined to lane-UNSCOPED code."""
+
+
+class Node:
+    def __init__(self, config, lanes):
+        self.config = config
+        self.lanes = lanes
+        self.epoch = 0
+        self.committed_batches = []
+
+    def lane_frontier(self, lane):
+        # the sanctioned accessor: the sibling carries its frontier
+        return self.lanes[lane].epoch
+
+    def settle_column(self, lane, items):
+        depth = len(self.lanes[lane].committed_batches)
+        return items[:depth]
+
+    def primary_frontier(self):
+        # no lane parameter: the primary lane's own frontier is
+        # exactly right here (== the merged frontier at lanes=1)
+        return self.epoch

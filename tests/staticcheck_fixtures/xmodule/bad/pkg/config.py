@@ -1,13 +1,15 @@
 """xmodule-bad config: xb_turbo is missing from the perfgate
-fingerprint; xb_nitro is never pinned in the equivalence tests."""
+fingerprint; xb_nitro is never pinned in the equivalence tests;
+xb_gears (an int arm) is pinned at only ONE value."""
 
 import dataclasses
 
-ARM_FLAGS = ("xb_turbo", "xb_nitro")
+ARM_FLAGS = ("xb_turbo", "xb_nitro", "xb_gears")
 
 
 @dataclasses.dataclass
 class Config:
     xb_turbo: bool = True
     xb_nitro: bool = True
+    xb_gears: int = 1
     batch: int = 8

@@ -6,6 +6,7 @@ snapshot, not about dead metrics)."""
 class Engine:
     def __init__(self, config, metrics):
         self._wave = bool(config.xb_turbo) and bool(config.xb_nitro)
+        self._gears = int(config.xb_gears)
         self.metrics = metrics
 
     def step(self, ok):

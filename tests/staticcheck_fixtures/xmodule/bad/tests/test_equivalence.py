@@ -1,8 +1,12 @@
 """xmodule-bad equivalence tests: xb_turbo is pinned on both arms;
-xb_nitro never is."""
+xb_nitro never is; xb_gears pins only the baseline value."""
 
 from pkg.config import Config
 
 
 def test_turbo_arms():
     assert Config(xb_turbo=False).batch == Config(xb_turbo=True).batch
+
+
+def test_gear_baseline_only():
+    assert Config(xb_gears=1).batch == Config(xb_gears=1).batch

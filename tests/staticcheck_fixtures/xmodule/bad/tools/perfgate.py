@@ -1,5 +1,5 @@
-"""xmodule-bad perfgate: the fingerprint carries xb_nitro but NOT
-xb_turbo."""
+"""xmodule-bad perfgate: the fingerprint carries xb_nitro and
+xb_gears but NOT xb_turbo."""
 
 
 def sample(cfg):
@@ -8,5 +8,6 @@ def sample(cfg):
         "fingerprint": {
             "kind": "mini",
             "xb_nitro": bool(cfg.xb_nitro),
+            "xb_gears": int(cfg.xb_gears),
         },
     }

@@ -1,11 +1,14 @@
-"""xmodule-good config: the arm flag is fingerprinted and pinned."""
+"""xmodule-good config: the bool arm flag is fingerprinted and
+pinned; the int arm flag is fingerprinted and pinned at two distinct
+values (baseline + fast arm)."""
 
 import dataclasses
 
-ARM_FLAGS = ("xg_turbo",)
+ARM_FLAGS = ("xg_turbo", "xg_gears")
 
 
 @dataclasses.dataclass
 class Config:
     xg_turbo: bool = True
+    xg_gears: int = 1
     batch: int = 8

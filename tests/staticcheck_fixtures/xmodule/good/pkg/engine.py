@@ -4,6 +4,7 @@
 class Engine:
     def __init__(self, config, metrics):
         self._wave = bool(config.xg_turbo)
+        self._gears = int(config.xg_gears)
         self.metrics = metrics
 
     def step(self):

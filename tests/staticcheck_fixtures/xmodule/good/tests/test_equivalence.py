@@ -1,7 +1,12 @@
-"""xmodule-good equivalence tests: the scalar arm is pinned."""
+"""xmodule-good equivalence tests: the scalar arm is pinned, and
+the int arm pins two distinct values."""
 
 from pkg.config import Config
 
 
 def test_turbo_arms():
     assert Config(xg_turbo=False).batch == Config(xg_turbo=True).batch
+
+
+def test_gear_arms():
+    assert Config(xg_gears=1).batch == Config(xg_gears=4).batch

@@ -1,4 +1,4 @@
-"""xmodule-good perfgate: the fingerprint keys on the arm flag."""
+"""xmodule-good perfgate: the fingerprint keys on both arm flags."""
 
 
 def sample(cfg):
@@ -7,5 +7,6 @@ def sample(cfg):
         "fingerprint": {
             "kind": "mini",
             "xg_turbo": bool(cfg.xg_turbo),
+            "xg_gears": int(cfg.xg_gears),
         },
     }

@@ -231,3 +231,19 @@ def test_fuzz_reconfig_deep_sweep():
             sample_schedule(seed, rounds=16, reconfig=True)
         )
         assert v is None, f"seed {seed}: {v}"
+
+
+@pytest.mark.slow
+def test_fuzz_lanes_deep_sweep():
+    """The lane shard-out deep band (ISSUE 20): 200 sampled composite
+    schedules with Config.lanes drawn from {2,3,4} per seed (appended
+    LAST, extending the historical stream) — S independent HBBFT
+    lanes over one roster, hash-partitioned admission and the
+    deterministic cross-lane merge — gating merge-determinism (every
+    honest node's merged total order byte-identical), cross-lane
+    settle-exactly-once, the per-lane two-frontier invariants and
+    liveness over the merged ledger (ci.sh runs the 0:20 smoke band
+    of this sampler; this is the RUN-SLOW extension)."""
+    for seed in range(20, 220):
+        v = run_schedule(sample_schedule(seed, lanes=True))
+        assert v is None, f"seed {seed}: {v}"

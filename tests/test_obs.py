@@ -280,6 +280,19 @@ def _golden_target() -> ObsTarget:
             "mempool_depth": 4,
         }
     )
+    # lane shard-out gauges (ISSUE 20): zeroed keys on every path;
+    # pinned to a two-lane shape so the golden scrape covers the
+    # per-lane labeled families
+    m.set_lanes(
+        lambda: {
+            "lanes": 2,
+            "merge_frontier": 5,
+            "ordered_epochs": [3, 2],
+            "settled_epochs": [3, 2],
+            "lane_fill": [8, 6],
+            "partition_skew": 2,
+        }
+    )
     m.set_transport_health(
         lambda: {
             'peer"q\\s': {

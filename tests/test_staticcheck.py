@@ -82,6 +82,11 @@ BAD_FIXTURES = [
     # a fixed-roster read is correct right up until the first
     # RECONFIG crosses, then a silent fork
     "protocol/det005_bad.py",
+    # the lane-frontier seam (ISSUE 20): lane-scoped protocol code
+    # reading the bare primary-lane epoch/settled/committed frontier
+    # still gates — a bare read silently pins lane 0's frontier the
+    # moment a second lane exists
+    "protocol/det005_lane_bad.py",
     # the egress wave-signer seam (ISSUE 13): per-frame envelope
     # encode+sign from a transport send path still gates — the
     # one-sign-pass-per-wave discipline can't silently erode back to
@@ -131,6 +136,7 @@ GOOD_FIXTURES = [
     "protocol/det003_good.py",
     "transport/det004_good.py",
     "protocol/det005_good.py",
+    "protocol/det005_lane_good.py",
     "transport/det006_good.py",
     "transport/wire001_good.py",
     "transport/pb001_good.py",

@@ -39,9 +39,12 @@ def test_xmodule_bad_tree_exact_cross_module_findings():
     assert _findings(XMODULE / "bad") == {
         # xb_turbo is read+pinned but missing from tools/perfgate.py's
         # fingerprint dict
-        ("ARM001", "pkg/config.py", 11),
-        # xb_nitro is read+fingerprinted but never pinned in tests/
         ("ARM001", "pkg/config.py", 12),
+        # xb_nitro is read+fingerprinted but never pinned in tests/
+        ("ARM001", "pkg/config.py", 13),
+        # xb_gears (int arm) is read+fingerprinted but pins only ONE
+        # distinct value in tests/ (the baseline; no fast-arm pin)
+        ("ARM001", "pkg/config.py", 14),
         # xb_lost_total is incremented in pkg/engine.py but never
         # reaches pkg/metrics.py's snapshot()
         ("SCHEMA001", "pkg/metrics.py", 16),

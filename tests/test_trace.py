@@ -192,9 +192,11 @@ def test_traced_cluster_validates_and_attributes(tmp_path):
     assert snap["trace"]["events_recorded"] > 0
     assert snap["trace"]["events_dropped"] == 0
     assert 0 < snap["trace"]["high_water"] <= Config(n=4).trace_buffer
-    # the report renders without error and names every epoch
+    # the report renders without error and names every epoch (windows
+    # key by (lane, epoch); single-lane artifacts are all lane 0)
     text = tracetool.report(doc)
-    for epoch in windows:
+    for lane, epoch in windows:
+        assert lane == 0
         assert f"epoch {epoch}:" in text
     summary = tracetool.summarize(doc)
     assert summary["hub"]["flushes"] > 0

@@ -65,6 +65,17 @@ overrides = json.loads(os.environ.get("ABENCH_CONFIG_OVERRIDES", "{}"))
 # roster at EQUAL f): an "n" in the overrides replaces the argv n for
 # that arm instead of colliding with it in the Config call
 n = int(overrides.pop("n", n))
+# pseudo-override "wan_profile" mounts the ISSUE-16 link model on the
+# cluster (it is a SimulatedCluster kwarg, not a Config field): the
+# ISSUE-20 lane A/B pairs tx-per-VIRTUAL-second across S, since wall
+# throughput in the serialized one-process scheduler pays every
+# lane's crypto sequentially and cannot show the shard-out win
+wan = overrides.pop("wan_profile", None)
+# a lanes override shards the arm into S sibling lanes (ISSUE 20);
+# the submitted tx mass scales by S so every lane runs SATURATED
+# epochs — the throughput-benchmark shape — and the per-settled-tx
+# cost fields stay directly comparable across unequal masses
+S = int(overrides.get("lanes", 1))
 # the production shape: work pre-submitted, auto-propose on, ONE
 # net.run chains every epoch back to back — the shape where cross-
 # epoch pipelining (old or two-frontier) is actually reachable.
@@ -75,10 +86,11 @@ cluster = SimulatedCluster(
     ),
     key_seed=77,
     auto_propose=True,
+    **({"wan_profile": wan} if wan else {}),
 )
 ids = cluster.ids
 rng = np.random.default_rng(13)
-for i in range(batch):  # warm-up epoch (compile, caches), its own txs
+for i in range(batch * S):  # warm-up epoch (compile, caches), its own txs
     cluster.nodes[ids[i % n]].add_transaction(
         rng.integers(0, 256, size=64, dtype=np.uint8).tobytes()
     )
@@ -86,19 +98,41 @@ for hb in cluster.nodes.values():  # explicit kick: add_transaction
     hb.start_epoch()               # never opens an epoch by itself
 cluster.net.run()
 assert len(cluster.nodes[ids[0]].committed_batches) >= 1
-for i in range(batch * epochs):
+for i in range(batch * epochs * S):
     cluster.nodes[ids[i % n]].add_transaction(
         rng.integers(0, 256, size=64, dtype=np.uint8).tobytes()
     )
 n0 = cluster.nodes[ids[0]]
-before = len(n0.committed_batches)
+
+
+def merged_log(node):
+    # the ISSUE-20 merged total order when the tree has lanes; the
+    # plain settled log (identical at lanes=1) on older refs
+    log = getattr(node, "merged_batches", None)
+    return log if log is not None else node.committed_batches
+
+
+def virtual_ms():
+    w = getattr(cluster.net, "wan", None)
+    return int(w.stats()["virtual_time_ms"]) if w is not None else None
+
+
+before = len(merged_log(n0))
+v_before = virtual_ms()
 t0 = time.perf_counter()
 for hb in cluster.nodes.values():  # kick; auto-propose chains on
     hb.start_epoch()
 cluster.net.run()
 elapsed = time.perf_counter() - t0
 cluster.assert_agreement()
-done = len(n0.committed_batches) - before
+window = merged_log(n0)[before:]
+done = len(window)
+settled_tx = sum(
+    sum(len(v) for v in b.contributions.values()) for b in window
+)
+v_window = (
+    virtual_ms() - v_before if v_before is not None else None
+)
 m = n0.metrics
 epoch_p50 = m.epoch_latency.p50
 ordered = getattr(m, "ordered_latency", None)
@@ -107,10 +141,24 @@ lag = getattr(m, "settle_lag_latency", None)
 lag_p95 = lag.p95 if lag is not None else None
 print(json.dumps({
     # per-epoch cadence over the chained run (wall / epochs): the
-    # throughput number a paired ratio compares
+    # throughput number a paired ratio compares (merged slots when
+    # the tree shards into lanes)
     "epoch_wall_ms": round(elapsed * 1000.0 / max(1, done), 3),
     "elapsed_ms": round(elapsed * 1000.0, 3),
     "epochs": done,
+    "settled_tx": settled_tx,
+    # wall microseconds per settled tx (per-unit cost: comparable
+    # across arms even when lane count scales the submitted mass)
+    "tx_wall_us": (
+        round(elapsed * 1e6 / settled_tx, 3) if settled_tx else None
+    ),
+    # virtual (link-model) microseconds per settled tx — only when a
+    # wan_profile override mounted the clock; the ISSUE-20 headline
+    "tx_virtual_us": (
+        round(v_window * 1000.0 / settled_tx, 3)
+        if v_window and settled_tx
+        else None
+    ),
     # per-epoch propose -> commit p50 from the node metrics (the
     # latency number; on two-frontier code this is the SETTLED p50)
     "epoch_p50_ms": (
@@ -275,6 +323,19 @@ def run_ab(
         )
         for h, b in zip(head, base)
     ]
+    # per-settled-tx cost ratios (ISSUE 20): the probe saturates each
+    # arm (its tx mass scales with the arm's lane count), so these
+    # pair ratios compare cost per unit of settled work (< 1 = HEAD
+    # cheaper per tx = higher throughput); the virtual one is
+    # non-null only when a wan_profile override mounted the clock
+    tx_wall_ratios = [
+        _ratio(h.get("tx_wall_us"), b.get("tx_wall_us"))
+        for h, b in zip(head, base)
+    ]
+    tx_virtual_ratios = [
+        _ratio(h.get("tx_virtual_us"), b.get("tx_virtual_us"))
+        for h, b in zip(head, base)
+    ]
 
     def med(rs):
         valid = [r for r in rs if r is not None]
@@ -305,11 +366,15 @@ def run_ab(
         "pair_epoch_p50_ratios": p50_ratios,
         "pair_ordered_p50_ratios": ordered_ratios,
         "pair_ordered_vs_ordered_ratios": ordered_vs_ordered,
+        "pair_tx_wall_ratios": tx_wall_ratios,
+        "pair_tx_virtual_ratios": tx_virtual_ratios,
         # < 1.0 = HEAD faster, same box, same moment
         "epoch_wall_ratio_median": med(wall_ratios),
         "epoch_p50_ratio_median": med(p50_ratios),
         "ordered_p50_ratio_median": med(ordered_ratios),
         "ordered_vs_ordered_ratio_median": med(ordered_vs_ordered),
+        "tx_wall_ratio_median": med(tx_wall_ratios),
+        "tx_virtual_ratio_median": med(tx_virtual_ratios),
     }
 
 

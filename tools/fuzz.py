@@ -105,6 +105,7 @@ def sample_schedule(
     wan_profile: Optional[str] = None,
     ingress: bool = False,
     reduced: bool = False,
+    lanes: bool = False,
 ) -> dict:
     """One composite fault schedule, a pure function of ``seed``.
 
@@ -151,7 +152,18 @@ def sample_schedule(
     EXCLUDED (the attested log converts it to detectable omission),
     not that arbitrary semantic lies are tolerated past n/3.  This
     band is a NEW seed stream (n and f are drawn differently by
-    construction); every reduced=False band's stream is untouched."""
+    construction); every reduced=False band's stream is untouched.
+
+    ``lanes=True`` (the lane shard-out band, ISSUE 20) draws a lane
+    count S from {2, 3, 4} — LAST of all keys, after the ingress
+    draw, so every older band's seed stream stays bit-identical —
+    and mounts Config.lanes=S: S independent HBBFT lanes over the
+    one roster, tx-hash-partitioned admission, and the deterministic
+    cross-lane total-order merge.  Gates the merge-determinism and
+    cross-lane settle-exactly-once invariants.  Incompatible with
+    ``reconfig`` (dynamic membership is a lanes=1 feature; Config
+    enforcement aside, the WAL lane framing has no reconfig
+    records)."""
     rng = random.Random(seed)
     if reduced:
         n = rng.choice((3, 5, 7))
@@ -267,6 +279,18 @@ def sample_schedule(
             "dup_fraction": round(rng.uniform(0.0, 0.4), 3),
             "client_seed": rng.randrange(1 << 16),
         }
+    lanes_n: Optional[int] = None
+    if lanes:
+        if reconfig:
+            raise ValueError(
+                "the lane band cannot compose with reconfig "
+                "(Config.lanes > 1 rejects dynamic membership)"
+            )
+        # lane shard-out (ISSUE 20): drawn LAST — the newest appended
+        # key, after the ingress draw — so non-lane replays of
+        # historical seeds are untouched and a lane schedule shares
+        # every other draw with its single-lane twin
+        lanes_n = rng.choice((2, 3, 4))
 
     out = {
         "version": SCHEDULE_VERSION,
@@ -288,6 +312,8 @@ def sample_schedule(
         out["wan_profile"] = wan_profile
     if ingress_cfg is not None:
         out["ingress"] = ingress_cfg
+    if lanes_n is not None:
+        out["lanes"] = lanes_n
     if reduced:
         # one key implies both flags: Config enforces that the
         # reduced quorum never mounts without the attested log
@@ -347,6 +373,9 @@ def _build_cluster(schedule: dict, trace: bool) -> SimulatedCluster:
         mempool_client_cap=(
             64 if ing is None else int(ing["client_cap"])
         ),
+        # lane shard-out band (ISSUE 20): absent on historical
+        # schedules (lanes=1 keeps the single-lane build bit-for-bit)
+        lanes=int(schedule.get("lanes", 1)),
     )
     cluster = SimulatedCluster(
         n=schedule["n"],
@@ -416,7 +445,12 @@ def _check_safety(cluster, honest: List[str], submitted: set, rnd: int):
                 rnd,
             )
     for h in honest:
-        for e, batch in enumerate(nodes[h].committed_batches):
+        # merged total order (== committed_batches at lanes=1): the
+        # foreign-tx sweep must cover EVERY lane's settled work, and
+        # a tx that settled in two lanes is a cross-lane
+        # exactly-once breach (ISSUE 20)
+        seen_txs: set = set()
+        for e, batch in enumerate(nodes[h].merged_batches):
             for tx in batch.tx_list():
                 if tx not in submitted and not is_protocol_tx(tx):
                     # reconfig-machinery txs (RECONFIG + dealings)
@@ -427,6 +461,31 @@ def _check_safety(cluster, honest: List[str], submitted: set, rnd: int):
                         f"in epoch {e}",
                         rnd,
                     )
+                if tx in seen_txs:
+                    raise Violation(
+                        "lane_exactly_once",
+                        f"{h} settled tx {tx!r} in two merged "
+                        f"slots (second at {e})",
+                        rnd,
+                    )
+                seen_txs.add(tx)
+    # -- merge determinism (ISSUE 20, Config.lanes > 1) ---------------
+    # every honest node's merged total order is byte-identical at the
+    # common merged frontier: the merge is a pure function of the
+    # committed lane streams, so a divergence here is a fork even
+    # when each per-lane ledger agrees
+    mdepth = min(nodes[h].merged_settled_frontier for h in honest)
+    for e in range(mdepth):
+        bodies = {
+            encode_batch_body(e, nodes[h].merged_batches[e])
+            for h in honest
+        }
+        if len(bodies) != 1:
+            raise Violation(
+                "merge_determinism",
+                f"honest MERGED orders fork at slot {e}",
+                rnd,
+            )
     # -- roster agreement (dynamic membership) ------------------------
     # every honest node that installed a roster version agrees on its
     # activation epoch and key-material digest (the committed ceremony
@@ -450,10 +509,12 @@ def _check_safety(cluster, honest: List[str], submitted: set, rnd: int):
                     rnd,
                 )
     # -- two-frontier invariants (ISSUE 8, Config.order_then_settle) --
+    # checked PER LANE (nodes[h].lanes is [self] at lanes=1): each
+    # lane runs its own ordered/settled frontier pair
     lag_max = cluster.config.decrypt_lag_max
-    ordered_depth = max(nodes[h].epoch for h in honest)
-    for h in honest:
-        hb = nodes[h]
+    for h, hb in (
+        (h, lane_hb) for h in honest for lane_hb in nodes[h].lanes
+    ):
         settled = len(hb.committed_batches)
         # backpressure bound: a coalition delaying settlement (share
         # forgery) may park ordering AT the bound, never push it past
@@ -493,19 +554,26 @@ def _check_safety(cluster, honest: List[str], submitted: set, rnd: int):
                 )
     # honest nodes' ordered logs are byte-identical wherever two of
     # them ordered the same epoch (the ACS output is one agreed value;
-    # COrd bodies are its canonical encoding)
-    for e in range(ordered_depth):
-        bodies = {
-            body
-            for h in honest
-            if (body := nodes[h].ordered_record(e)) is not None
-        }
-        if len(bodies) > 1:
-            raise Violation(
-                "ordered_agreement",
-                f"honest ORDERED logs fork at epoch {e}",
-                rnd,
-            )
+    # COrd bodies are its canonical encoding) — checked per lane
+    # (every honest node runs the same Config.lanes; min() guards a
+    # mid-bootstrap joiner's view)
+    n_lanes = min(len(nodes[h].lanes) for h in honest)
+    for k in range(n_lanes):
+        ordered_depth = max(nodes[h].lanes[k].epoch for h in honest)
+        for e in range(ordered_depth):
+            bodies = {
+                body
+                for h in honest
+                if (body := nodes[h].lanes[k].ordered_record(e))
+                is not None
+            }
+            if len(bodies) > 1:
+                raise Violation(
+                    "ordered_agreement",
+                    f"honest ORDERED logs fork at lane {k} "
+                    f"epoch {e}",
+                    rnd,
+                )
 
 
 def _ingress_submit(
@@ -768,7 +836,7 @@ def run_schedule(
         for h in final:
             committed = {
                 tx
-                for b in cluster.nodes[h].committed_batches
+                for b in cluster.nodes[h].merged_batches
                 for tx in b.tx_list()
             }
             missing = submitted - committed
@@ -893,6 +961,7 @@ def fuzz_seeds(
     wan_profile: Optional[str] = None,
     ingress: bool = False,
     reduced: bool = False,
+    lanes: bool = False,
 ) -> int:
     """Run a schedule per seed; on the first violation, shrink it and
     emit a repro file plus (by default) a flight-recorder trace
@@ -911,6 +980,7 @@ def fuzz_seeds(
             wan_profile=wan_profile,
             ingress=ingress,
             reduced=reduced,
+            lanes=lanes,
         )
         violation = run_schedule(schedule)
         if violation is None:
@@ -985,6 +1055,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         "no-false-accusation and settle-exactly-once invariants",
     )
     ap.add_argument(
+        "--lanes",
+        action="store_true",
+        help="lane shard-out band (ISSUE 20): draw Config.lanes "
+        "from {2,3,4} per seed, appended LAST so historical seed "
+        "streams extend; gates the merge-determinism and "
+        "cross-lane settle-exactly-once invariants",
+    )
+    ap.add_argument(
         "--show", action="store_true", help="print the schedule, no run"
     )
     ap.add_argument("--repro", help="replay a repro file")
@@ -1028,6 +1106,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 wan_profile=args.wan_profile,
                 ingress=args.ingress,
                 reduced=args.reduced_quorum,
+                lanes=args.lanes,
             )
             json.dump(schedule, sys.stdout, indent=2, sort_keys=True)
             print()
@@ -1044,6 +1123,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         wan_profile=args.wan_profile,
         ingress=args.ingress,
         reduced=args.reduced_quorum,
+        lanes=args.lanes,
     )
 
 

@@ -126,6 +126,7 @@ def run_arm(
     n: int = DEFAULT_N,
     batch: int = DEFAULT_BATCH,
     seed: int = DEFAULT_SEED,
+    lanes: int = 1,
     max_drain_rounds: int = 400,
     wan_profile: Optional[str] = None,
     progress=None,
@@ -145,6 +146,10 @@ def run_arm(
         batch_size=batch,
         seed=seed,
         crypto_backend="cpu",
+        # lanes > 1 shards the schedule across S consensus lanes: the
+        # mempool's admit() routes each tx by seeded digest hash, so
+        # loadgen exercises the production partitioner, not its own
+        lanes=lanes,
         epoch_pipelining=depth > 1,
         pipeline_depth=depth,
         # keep validation headroom: reconfig_lead must exceed
@@ -176,12 +181,14 @@ def run_arm(
     seen_ordered = seen_settled = 0
 
     def record_frontiers() -> None:
+        # MERGED frontiers (== epoch/settled_epoch at lanes=1): slot
+        # timestamps and the exactly-once audit span every lane
         nonlocal seen_ordered, seen_settled
         now = time.perf_counter()
-        while seen_ordered < node0.epoch:
+        while seen_ordered < node0.merged_ordered_frontier:
             t_ordered[seen_ordered] = now
             seen_ordered += 1
-        while seen_settled < node0.settled_epoch:
+        while seen_settled < node0.merged_settled_frontier:
             t_settled[seen_settled] = now
             seen_settled += 1
 
@@ -226,7 +233,8 @@ def run_arm(
     # catches up and nothing is pending anywhere
     rounds = 0
     while rounds < max_drain_rounds and (
-        cluster.pending() > 0 or node0.settled_epoch < node0.epoch
+        cluster.pending() > 0
+        or node0.merged_settled_frontier < node0.merged_ordered_frontier
     ):
         one_round()
         rounds += 1
@@ -236,7 +244,10 @@ def run_arm(
     assert acks == txs_total, f"lost acks: {acks} != {txs_total}"
     settle_epoch: Dict[bytes, int] = {}
     dup_settles = 0
-    for e, b in enumerate(node0.committed_batches):
+    # merged total order: a tx that settled in two different lanes
+    # would surface as a duplicate here — the cross-lane
+    # exactly-once audit (== the single-lane one at lanes=1)
+    for e, b in enumerate(node0.merged_batches):
         for tx in b.tx_list():
             if tx in settle_epoch:
                 dup_settles += 1
@@ -249,11 +260,12 @@ def run_arm(
     assert len(lost) == evicted, (
         f"{len(lost)} OK-acked txs unsettled but only {evicted} evictions"
     )
-    assert node0.settled_epoch == node0.epoch, (
-        f"settled frontier {node0.settled_epoch} trails ordered "
-        f"{node0.epoch} after drain"
+    assert node0.merged_settled_frontier == node0.merged_ordered_frontier, (
+        f"merged settled frontier {node0.merged_settled_frontier} trails "
+        f"ordered {node0.merged_ordered_frontier} after drain"
     )
     cluster.assert_agreement()
+    lane_fill = node0.mempool.lane_fill()
     ledger = hashlib.sha256()
     for tx in sorted(settle_epoch):
         ledger.update(tx)
@@ -273,13 +285,16 @@ def run_arm(
     wall = t_end - t_start
     return {
         "depth": depth,
+        "lanes": lanes,
+        "lane_fill": lane_fill,
+        "lane_skew": max(lane_fill) - min(lane_fill),
         "wan_profile": wan_profile,
         "clients": len({c for tick in schedule for (c, _, _, _) in tick}),
         "txs": txs_total,
         "settled": len(settle_epoch),
         "evicted": evicted,
         "statuses": dict(sorted(status_counts.items())),
-        "epochs": node0.settled_epoch,
+        "epochs": node0.merged_settled_frontier,
         "drain_rounds": rounds,
         "wall_s": round(wall, 3),
         "tx_per_s": round(len(settle_epoch) / wall, 1) if wall else 0.0,
@@ -305,6 +320,7 @@ def run(
     batch: int = DEFAULT_BATCH,
     ticks: int = DEFAULT_TICKS,
     seed: int = DEFAULT_SEED,
+    lanes: int = 1,
     quiet: bool = False,
 ) -> Dict:
     """All arms over one shared schedule + the cross-arm audit."""
@@ -315,9 +331,13 @@ def run(
     for depth in depths:
         if not quiet:
             print(f"[loadgen] arm depth={depth}: {txs} txs, "
-                  f"{clients} clients, {ticks} ticks", flush=True)
+                  f"{clients} clients, {ticks} ticks, "
+                  f"{lanes} lane(s)", flush=True)
         arms.append(
-            run_arm(schedule, depth=depth, n=n, batch=batch, seed=seed)
+            run_arm(
+                schedule, depth=depth, n=n, batch=batch, seed=seed,
+                lanes=lanes,
+            )
         )
         if not quiet:
             a = arms[-1]
@@ -327,7 +347,8 @@ def run(
                 f"ordered p50 {a['submit_to_ordered_ms']['p50']}ms "
                 f"p99 {a['submit_to_ordered_ms']['p99']}ms, "
                 f"settled p50 {a['submit_to_settled_ms']['p50']}ms "
-                f"p99 {a['submit_to_settled_ms']['p99']}ms",
+                f"p99 {a['submit_to_settled_ms']['p99']}ms"
+                + (f", lane skew {a['lane_skew']}" if lanes > 1 else ""),
                 flush=True,
             )
     digests = {a["ledger_digest"] for a in arms}
@@ -357,6 +378,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--ticks", type=int, default=DEFAULT_TICKS)
     ap.add_argument("--seed", type=int, default=DEFAULT_SEED)
     ap.add_argument(
+        "--lanes", type=int, default=1,
+        help="consensus lanes (Config.lanes); submits shard across "
+        "lanes through the production hash partitioner",
+    )
+    ap.add_argument(
         "--depths", default=",".join(str(d) for d in DEFAULT_DEPTHS),
         help="comma-separated pipeline depths, one arm each",
     )
@@ -383,6 +409,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         batch=args.batch,
         ticks=args.ticks,
         seed=args.seed,
+        lanes=args.lanes,
     )
     if args.json:
         with open(args.json, "w", encoding="utf-8") as fh:

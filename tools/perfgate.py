@@ -200,6 +200,34 @@ def append_bench_trend(result: Dict, path: str = str(DEFAULT_TREND)) -> int:
                 }
                 append_record(path, record)
                 appended += 1
+        # lane shard-out cadence (ISSUE 20): one record per lane-count
+        # arm of the bench lane_scaling section — the virtual-time
+        # throughput and dispatch-flatness trend across S
+        lanes = result.get("lane_scaling")
+        if isinstance(lanes, dict):
+            for arm, body in lanes.get("arms", {}).items():
+                if not isinstance(body, dict):
+                    continue
+                append_record(path, {
+                    "kind": "bench_lane_scaling",
+                    "ts": stamp,
+                    "fingerprint": {
+                        "kind": "bench_lane_scaling",
+                        "arm": arm,
+                        "lanes": body.get("lanes"),
+                        "n": body.get("n"),
+                        "batch": body.get("batch"),
+                        "platform": platform,
+                    },
+                    "tx_per_virtual_sec": body.get("tx_per_virtual_sec"),
+                    "wall_tx_per_sec": body.get("wall_tx_per_sec"),
+                    "virtual_ms_per_slot": body.get("virtual_ms_per_slot"),
+                    "merged_slots": body.get("merged_slots"),
+                    "hub_dispatches_per_ordered_epoch": body.get(
+                        "hub_dispatches_per_ordered_epoch"
+                    ),
+                })
+                appended += 1
     except OSError:
         pass
     return appended
@@ -311,6 +339,12 @@ def run_sample(
             # trend records
             "attested_log": bool(cfg.attested_log),
             "reduced_quorum": bool(cfg.reduced_quorum),
+            # lane shard-out (ISSUE 20): S lanes share each wave's
+            # dispatches, so every per-epoch counter and latency
+            # window MEANS something different at a different S —
+            # runs gate only against same-lane-count trend records
+            # (the int-valued arm key; staticcheck ARM001 checks it)
+            "lanes": int(cfg.lanes),
             # the ingress mini-load's shape changes what the
             # submit->ordered p50 and the eviction count MEAN —
             # reshaping it re-keys the trend (run --reset after an

@@ -54,6 +54,9 @@ PB_RESERVED_TAGS = frozenset((1, 2, 3, 4))
 
 _WAVE_SUFFIX = "_wave"
 _BOOL_FLAG_PIN_RE = r"\b{flag}\s*=\s*(?:True|False)\b"
+# int-valued arms (Config.lanes): the pin is a literal integer, and
+# the rule wants the DISTINCT values (baseline=1 vs shard-out>1)
+_INT_FLAG_PIN_RE = r"\b{flag}\s*=\s*(\d+)"
 
 
 def is_fixture_path(relpath: str) -> bool:
@@ -110,10 +113,11 @@ class ExpoModule:
 
 @dataclasses.dataclass
 class ConfigModule:
-    """One arm-flag registry: Config bool fields + ARM_FLAGS."""
+    """One arm-flag registry: Config bool/int fields + ARM_FLAGS."""
 
     relpath: str
     bool_fields: Dict[str, int]  # field -> line
+    int_fields: Dict[str, int]  # field -> line (int-valued arms)
     arm_flags: List[str]
     arm_flags_line: int
 
@@ -154,6 +158,21 @@ class ProgramIndex:
             )
             is not None
         )
+
+    def int_flag_pin_values(self, flag: str) -> Set[int]:
+        """Distinct integer literals tests pin the flag to.  An
+        int-valued arm (Config.lanes) needs >= 2 of them: the
+        byte-equivalence baseline value AND a shard-out value, or the
+        fast arm has no equivalence coverage."""
+        if self.test_flag_pins is None:
+            return set()
+        return {
+            int(m)
+            for m in re.findall(
+                _INT_FLAG_PIN_RE.format(flag=re.escape(flag)),
+                self.test_flag_pins,
+            )
+        }
 
 
 def is_wave_entry_name(name: str) -> bool:
@@ -317,6 +336,10 @@ def _bool_annotation(ann: Optional[ast.AST]) -> bool:
     return isinstance(ann, ast.Name) and ann.id == "bool"
 
 
+def _int_annotation(ann: Optional[ast.AST]) -> bool:
+    return isinstance(ann, ast.Name) and ann.id == "int"
+
+
 def _extract_config(ctx) -> Optional[ConfigModule]:
     cls = None
     for node in ast.iter_child_nodes(ctx.tree):
@@ -344,16 +367,21 @@ def _extract_config(ctx) -> Optional[ConfigModule]:
     if arm_flags is None:
         return None
     bool_fields: Dict[str, int] = {}
+    int_fields: Dict[str, int] = {}
     for node in cls.body:
-        if (
+        if not (
             isinstance(node, ast.AnnAssign)
             and isinstance(node.target, ast.Name)
-            and _bool_annotation(node.annotation)
         ):
+            continue
+        if _bool_annotation(node.annotation):
             bool_fields[node.target.id] = node.lineno
+        elif _int_annotation(node.annotation):
+            int_fields[node.target.id] = node.lineno
     return ConfigModule(
         relpath=ctx.relpath,
         bool_fields=bool_fields,
+        int_fields=int_fields,
         arm_flags=arm_flags,
         arm_flags_line=arm_line,
     )

@@ -245,10 +245,11 @@ class Schema001MetricsContract:
 class Arm001WaveArmParity:
     id = "ARM001"
     doc = (
-        "every ARM_FLAGS entry must be a bool Config field, read by "
-        "the package, pinned explicitly in tests, and a perfgate "
-        "fingerprint key; every *_wave entry point must be reachable "
-        "from an arm-flag-reading module (the scalar-arm gate)"
+        "every ARM_FLAGS entry must be a bool or int Config field, "
+        "read by the package, pinned explicitly in tests (>= 2 "
+        "distinct values for int arms), and a perfgate fingerprint "
+        "key; every *_wave entry point must be reachable from an "
+        "arm-flag-reading module (the scalar-arm gate)"
     )
 
     def check_program(
@@ -258,15 +259,20 @@ class Arm001WaveArmParity:
             return
         for c in index.config_modules:
             for flag in c.arm_flags:
-                if flag not in c.bool_fields:
+                is_int_arm = flag in c.int_fields
+                if flag not in c.bool_fields and not is_int_arm:
                     yield _program_finding(
                         self.id, c.relpath, c.arm_flags_line,
-                        f"ARM_FLAGS entry {flag!r} is not a bool "
-                        "Config field (stale registry entry)",
+                        f"ARM_FLAGS entry {flag!r} is not a bool or "
+                        "int Config field (stale registry entry)",
                         ctx_map,
                     )
                     continue
-                line = c.bool_fields[flag]
+                line = (
+                    c.int_fields[flag]
+                    if is_int_arm
+                    else c.bool_fields[flag]
+                )
                 # never-read convicts the consumers; a lone-real-file
                 # scan has none in view (same rule as SCHEMA001)
                 if (
@@ -292,10 +298,22 @@ class Arm001WaveArmParity:
                         "against the other mode's trend records",
                         ctx_map,
                     )
-                if (
-                    index.test_flag_pins is not None
-                    and not index.flag_pinned_in_tests(flag)
-                ):
+                if index.test_flag_pins is None:
+                    continue
+                if is_int_arm:
+                    # an int arm (Config.lanes) needs the baseline
+                    # value AND a fast-path value pinned, or the
+                    # byte-equivalence comparison never runs
+                    if len(index.int_flag_pin_values(flag)) < 2:
+                        yield _program_finding(
+                            self.id, c.relpath, line,
+                            f"int arm flag {flag!r} pins fewer than "
+                            "2 distinct values in tests; both the "
+                            "byte-equivalence baseline and the fast "
+                            "arm need explicit coverage",
+                            ctx_map,
+                        )
+                elif not index.flag_pinned_in_tests(flag):
                     yield _program_finding(
                         self.id, c.relpath, line,
                         f"arm flag {flag!r} is never pinned "

@@ -384,6 +384,15 @@ _DET005_EXEMPT_FILES = frozenset(
 )
 _DET005_CONFIG_FIELDS = frozenset(("n", "f", "decryption_threshold"))
 _DET005_SELF_ATTRS = frozenset(("members", "_member_set", "keys"))
+# Lane shard-out (ISSUE 20) made the epoch frontier a PER-LANE value:
+# code handed a lane index must resolve frontiers through the
+# lane-indexed accessor (self.lanes[lane].epoch / the merged_*
+# accessors), never the bare primary-lane attributes — a bare read is
+# correct at lanes=1 and silently pins lane 0's frontier the moment a
+# second lane exists.
+_DET005_LANE_FRONTIER_ATTRS = frozenset(
+    ("epoch", "settled_epoch", "committed_batches")
+)
 
 
 @rule
@@ -393,7 +402,11 @@ class Det005RosterVersionAccessor:
         "epoch-scoped protocol code (functions taking an epoch "
         "parameter) must resolve n/f/keys/membership via "
         "roster_for(epoch) / the epoch state's view, not the "
-        "construction-time self.config.n / self.members / self.keys"
+        "construction-time self.config.n / self.members / self.keys; "
+        "lane-scoped code (functions taking a lane parameter) must "
+        "resolve frontiers via the lane-indexed accessor "
+        "(self.lanes[lane] / merged_*), not the bare primary-lane "
+        "self.epoch / self.settled_epoch / self.committed_batches"
     )
 
     def check(self, ctx: FileContext) -> Iterator[Finding]:
@@ -410,6 +423,8 @@ class Det005RosterVersionAccessor:
                     args.posonlyargs + args.args + args.kwonlyargs
                 )
             ]
+            if any("lane" in a for a in names):
+                yield from self._check_lane_scoped(ctx, fn)
             if not any("epoch" in a for a in names):
                 continue
             for node in ast.walk(fn):
@@ -441,6 +456,34 @@ class Det005RosterVersionAccessor:
                         "resolve the epoch's roster via "
                         "roster_for(epoch)/es.view instead",
                     )
+
+    def _check_lane_scoped(
+        self, ctx: FileContext, fn: ast.AST
+    ) -> Iterator[Finding]:
+        """Lane-scoped code reading the bare primary-lane frontier
+        (Load contexts only: lane objects still initialize their own
+        ``self.epoch``).  Constructors are exempt: an object built
+        WITH a lane id IS that lane, and its __init__ legitimately
+        wires/replays its own frontier — the hazard is cross-lane
+        aggregation code handed a lane INDEX."""
+        if getattr(fn, "name", "") == "__init__":
+            return
+        for node in ast.walk(fn):
+            if (
+                isinstance(node, ast.Attribute)
+                and isinstance(node.ctx, ast.Load)
+                and node.attr in _DET005_LANE_FRONTIER_ATTRS
+                and _self_attr(node) is not None
+            ):
+                yield ctx.finding(
+                    self.id,
+                    node,
+                    f"lane-scoped {fn.name}() reads "
+                    f"self.{node.attr} (the PRIMARY lane's "
+                    "frontier); resolve through the lane-indexed "
+                    "accessor self.lanes[lane] / the merged_* "
+                    "frontier accessors instead",
+                )
 
 
 # ---------------------------------------------------------------------------

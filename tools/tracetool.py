@@ -134,9 +134,14 @@ def _point(ev: dict) -> float:
     return float(ev["ts"]) + float(ev.get("dur", 0.0))
 
 
-def epoch_windows(doc: dict) -> Dict[int, Tuple[float, float]]:
-    """epoch -> (us of earliest open, us of latest close), for every
-    epoch with both markers.
+def epoch_windows(doc: dict) -> Dict[Tuple[int, int], Tuple[float, float]]:
+    """(lane, epoch) -> (us of earliest open, us of latest close),
+    for every epoch with both markers.  Lane-sharded artifacts
+    (Config.lanes > 1) tag epoch events with a ``lane`` arg; lanes
+    reuse epoch numbers, so the key must carry the lane or the
+    windows of S concurrent epoch-k runs would merge into one bogus
+    span.  Single-lane artifacts carry no ``lane`` arg and key as
+    lane 0 — the historical window set, unchanged.
 
     The closing marker is the latest ``epoch/ordered`` instant when
     the artifact carries one for that epoch (the two-frontier commit
@@ -145,30 +150,32 @@ def epoch_windows(doc: dict) -> Dict[int, Tuple[float, float]]:
     track, visible as the ``settle/decrypt_lag`` spans outside these
     windows), falling back to the latest ``epoch/commit`` on coupled
     artifacts."""
-    opens: Dict[int, float] = {}
-    commits: Dict[int, float] = {}
-    ordereds: Dict[int, float] = {}
+    opens: Dict[Tuple[int, int], float] = {}
+    commits: Dict[Tuple[int, int], float] = {}
+    ordereds: Dict[Tuple[int, int], float] = {}
     for ev in _analysis_events(doc):
         if ev.get("cat") != "epoch":
             continue
-        epoch = ev.get("args", {}).get("epoch")
+        args = ev.get("args", {})
+        epoch = args.get("epoch")
         if not isinstance(epoch, int):
             continue
+        key = (int(args.get("lane", 0)), epoch)
         ts = float(ev["ts"])
         if ev["name"] == "open":
-            if epoch not in opens or ts < opens[epoch]:
-                opens[epoch] = ts
+            if key not in opens or ts < opens[key]:
+                opens[key] = ts
         elif ev["name"] == "commit":
-            if epoch not in commits or ts > commits[epoch]:
-                commits[epoch] = ts
+            if key not in commits or ts > commits[key]:
+                commits[key] = ts
         elif ev["name"] == "ordered":
-            if epoch not in ordereds or ts > ordereds[epoch]:
-                ordereds[epoch] = ts
+            if key not in ordereds or ts > ordereds[key]:
+                ordereds[key] = ts
     closes = {**commits, **ordereds}  # ordered wins where present
     return {
-        e: (opens[e], closes[e])
-        for e in sorted(opens)
-        if e in closes and closes[e] > opens[e]
+        k: (opens[k], closes[k])
+        for k in sorted(opens)
+        if k in closes and closes[k] > opens[k]
     }
 
 
@@ -291,6 +298,11 @@ def summarize(doc: dict) -> dict:
         "coin_share_items": 0,
     }
     batch_widths: List[float] = []
+    # lane shard-out (ISSUE 20): epoch events on lane-sharded
+    # artifacts carry a ``lane`` arg; merge/emit instants mark the
+    # total-order slots the cross-lane merge released
+    lane_ordered: Dict[int, int] = {}
+    merge_emits = 0
     for ev in _analysis_events(doc):
         cat = ev["cat"]
         by_cat[cat] = by_cat.get(cat, 0) + 1
@@ -299,6 +311,11 @@ def summarize(doc: dict) -> dict:
                 float(ev.get("dur", 0.0))
             )
         args = ev.get("args", {})
+        if cat == "epoch" and ev["name"] in ("ordered", "commit"):
+            lane = int(args.get("lane", 0))
+            lane_ordered[lane] = lane_ordered.get(lane, 0) + 1
+        elif cat == "merge" and ev["name"] == "emit":
+            merge_emits += 1
         if cat == "hub" and ev["name"] == "flush":
             hub["flushes"] += 1
             for k in ("dispatches", "branches", "decodes", "shares"):
@@ -348,6 +365,11 @@ def summarize(doc: dict) -> dict:
         "events_by_category": dict(sorted(by_cat.items())),
         "hub": hub,
         "delivery": delivery,
+        "lanes": {
+            "count": (max(lane_ordered) + 1) if lane_ordered else 1,
+            "ordered_by_lane": dict(sorted(lane_ordered.items())),
+            "merge_emits": merge_emits,
+        },
         "wave_size_p50": _percentile(wave_sizes, 50),
         "wave_size_p95": _percentile(wave_sizes, 95),
         "spans": spans,
@@ -362,12 +384,13 @@ def report(doc: dict, top: int = 5) -> str:
     points = sorted_points(doc)
     if not windows:
         lines.append("no complete epochs (open+commit) in the artifact")
-    for epoch, (t_open, t_commit) in windows.items():
+    for (lane, epoch), (t_open, t_commit) in windows.items():
         wall = t_commit - t_open
         shares, chain = attribute_epoch(doc, t_open, t_commit, points)
         covered = sum(shares.values())
+        label = f"epoch {epoch}" if lane == 0 else f"epoch {epoch} lane {lane}"
         lines.append(
-            f"epoch {epoch}: wall {wall / 1000.0:.3f} ms, "
+            f"{label}: wall {wall / 1000.0:.3f} ms, "
             f"{100.0 * covered / wall:.1f}% attributed"
         )
         for cat, us in sorted(
@@ -390,6 +413,8 @@ def report(doc: dict, top: int = 5) -> str:
     lines.append(f"  events by category: {s['events_by_category']}")
     lines.append(f"  hub: {s['hub']}")
     lines.append(f"  delivery: {s['delivery']}")
+    if s["lanes"]["count"] > 1:
+        lines.append(f"  lanes: {s['lanes']}")
     lines.append(
         f"  wave size p50/p95: {s['wave_size_p50']}/{s['wave_size_p95']}"
     )
